@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Benchmark: fleet training throughput + server scoring throughput on the
+available accelerator (BASELINE.md configs 1/3/5 rolled into the headline
+metric: autoencoder models trained / hour / chip).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The reference publishes no numbers (BASELINE.md); the driver-recorded
+reference practice is one Keras model per builder pod. ``vs_baseline``
+compares against a measured single-model sequential rate on the same
+hardware (i.e. the reference's one-at-a-time architecture transplanted
+here), so it captures the speedup of many-model vmap/shard_map training
+over pod-style sequential builds.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _synth_fleet(n_models: int, rows: int, n_features: int):
+    rng = np.random.RandomState(0)
+    t = np.arange(rows)
+    out = {}
+    for i in range(n_models):
+        freqs = 0.01 + 0.002 * rng.rand(n_features)
+        phases = 2 * np.pi * rng.rand(n_features)
+        X = np.sin(np.outer(t, freqs) + phases) + rng.normal(
+            scale=0.05, size=(rows, n_features)
+        )
+        out[f"machine-{i}"] = X.astype("float32")
+    return out
+
+
+def bench_fleet(n_models=256, rows=1440, n_features=10, epochs=5, batch_size=128):
+    """Many-model fleet training: models/hour/chip."""
+    import jax
+
+    from gordo_components_tpu.parallel import FleetTrainer
+
+    members = _synth_fleet(n_models, rows, n_features)
+    trainer = FleetTrainer(
+        kind="feedforward_hourglass",
+        epochs=epochs,
+        batch_size=batch_size,
+        compute_dtype="bfloat16",
+    )
+    # warmup/compile on a small shard so the timed run measures steady state
+    warm = {k: members[k] for k in list(members)[: len(jax.devices())]}
+    FleetTrainer(
+        kind="feedforward_hourglass", epochs=1, batch_size=batch_size,
+        compute_dtype="bfloat16",
+    ).fit(warm)
+
+    t0 = time.time()
+    trainer.fit(members)
+    elapsed = time.time() - t0
+    n_chips = len(jax.devices())
+    models_per_hour_per_chip = n_models / elapsed * 3600 / n_chips
+    return models_per_hour_per_chip, elapsed
+
+
+def bench_single_sequential(rows=1440, n_features=10, epochs=5, batch_size=128, n_probe=3):
+    """Reference-architecture stand-in: one model at a time (pod-style)."""
+    from gordo_components_tpu.models import AutoEncoder
+
+    members = _synth_fleet(n_probe, rows, n_features)
+    # compile warmup
+    AutoEncoder(kind="feedforward_hourglass", epochs=1, batch_size=batch_size).fit(
+        next(iter(members.values()))
+    )
+    t0 = time.time()
+    for X in members.values():
+        AutoEncoder(
+            kind="feedforward_hourglass", epochs=epochs, batch_size=batch_size
+        ).fit(X)
+    elapsed = time.time() - t0
+    return n_probe / elapsed * 3600, elapsed
+
+
+def bench_server_scoring(n_features=10, batch=4096, iters=20):
+    """Reconstruction-error samples/sec through the jit'd scoring path."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_components_tpu.models.factories import feedforward_hourglass
+    from gordo_components_tpu.ops.scaler import fit_minmax, scaler_transform
+
+    module = feedforward_hourglass(n_features, compute_dtype="bfloat16")
+    rng = jax.random.PRNGKey(0)
+    X = jax.random.normal(rng, (batch, n_features), dtype=jnp.float32)
+    params = module.init(rng, X[:1])
+    scaler = fit_minmax(X)
+
+    @jax.jit
+    def score(params, scaler, X):
+        Xs = scaler_transform(scaler, X)
+        recon = module.apply(params, Xs)
+        return jnp.linalg.norm(jnp.abs(Xs - recon), axis=-1)
+
+    score(params, scaler, X).block_until_ready()  # compile
+    t0 = time.time()
+    for _ in range(iters):
+        out = score(params, scaler, X)
+    out.block_until_ready()
+    elapsed = time.time() - t0
+    return batch * iters / elapsed
+
+
+def main():
+    fleet_rate, fleet_s = bench_fleet()
+    seq_rate, _ = bench_single_sequential()
+    samples_per_sec = bench_server_scoring()
+
+    result = {
+        "metric": "autoencoder models trained/hour/chip (fleet vmap engine)",
+        "value": round(fleet_rate, 1),
+        "unit": "models/hour/chip",
+        "vs_baseline": round(fleet_rate / seq_rate, 2) if seq_rate else None,
+        "detail": {
+            "fleet_models_per_hour_per_chip": round(fleet_rate, 1),
+            "sequential_models_per_hour_per_chip": round(seq_rate, 1),
+            "fleet_wall_seconds_256_models": round(fleet_s, 2),
+            "server_recon_samples_per_sec": round(samples_per_sec, 1),
+            "config": "256 models x 1440 rows x 10 tags, hourglass AE, 5 epochs, bf16",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

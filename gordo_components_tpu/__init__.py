@@ -1,0 +1,24 @@
+"""gordo-components-tpu: a TPU-native rebuild of gordo-components.
+
+A framework for building, training, serializing, and serving thousands of
+per-machine time-series anomaly-detection models (autoencoders over sensor
+tags) from a single declarative fleet config — designed JAX-first:
+
+- models are Flax modules trained with jit'd optax loops (MXU-friendly,
+  bfloat16-capable, static shapes),
+- model *fleets* are stacked pytrees trained with ``vmap`` over the model
+  axis and sharded across a ``jax.sharding.Mesh`` with ``shard_map``,
+- artifacts are directory trees (numpy-serialized pytrees + metadata.json)
+  round-trippable through the config serializer,
+- serving is an aiohttp app scoring batched reconstruction error on-device.
+
+Reference parity: mirrors the capability surface of
+``flikka/gordo-components`` (see SURVEY.md; the reference mount was empty at
+survey time, so citations are of the form ``gordo_components/<path>
+(unverified)``).
+"""
+
+__version__ = "0.1.0"
+
+MAJOR_VERSION = 0
+MINOR_VERSION = 1

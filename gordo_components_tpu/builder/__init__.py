@@ -1,0 +1,10 @@
+"""Builder layer (reference parity: gordo_components/builder/build_model.py,
+unverified — SURVEY.md §2 "builder")."""
+
+from gordo_components_tpu.builder.build_model import (
+    build_model,
+    calculate_model_key,
+    provide_saved_model,
+)
+
+__all__ = ["build_model", "provide_saved_model", "calculate_model_key"]

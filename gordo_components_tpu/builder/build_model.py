@@ -1,0 +1,206 @@
+"""Train-one-model pipeline.
+
+Reference parity: ``build_model`` / ``provide_saved_model`` /
+``calculate_model_key`` (gordo_components/builder/build_model.py,
+unverified; SURVEY.md §2 "builder", §3.1): dataset → pipeline instantiation
+(serializer) → optional TimeSeriesSplit cross-validation → fit → metadata
+assembly → artifact dump, with a config-hash build cache so rerunning a
+fleet skips machines whose artifact already exists — the semantics that make
+10k-model reruns cheap (SURVEY.md §5 "Checkpoint/resume").
+"""
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from gordo_components_tpu import __version__
+from gordo_components_tpu.dataset import get_dataset
+from gordo_components_tpu import serializer
+from gordo_components_tpu.utils import metadata_timestamp
+
+logger = logging.getLogger(__name__)
+
+
+def build_model(
+    name: str,
+    model_config: Dict[str, Any],
+    data_config: Dict[str, Any],
+    metadata: Optional[Dict[str, Any]] = None,
+    evaluation_config: Optional[Dict[str, Any]] = None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Build and train a single model; returns ``(model, metadata)``.
+
+    ``evaluation_config``: ``{"cv_mode": "full_build" | "cross_val_only",
+    "n_splits": 3}`` — TimeSeriesSplit cross-validation with
+    explained-variance scores recorded into metadata (reference behavior).
+    """
+    metadata = dict(metadata or {})
+    evaluation_config = {"cv_mode": "full_build", **(evaluation_config or {})}
+
+    t0 = time.time()
+    dataset = get_dataset(dict(data_config))
+    X, y = dataset.get_data()
+    data_elapsed = time.time() - t0
+
+    model = serializer.from_definition(model_config)
+
+    cv_meta: Dict[str, Any] = {}
+    n_splits = int(evaluation_config.get("n_splits", 3))
+    wants_cv = evaluation_config["cv_mode"] == "cross_val_only" or evaluation_config.get(
+        "cross_validation", False
+    )
+    if wants_cv and n_splits > 0:
+        cv_meta = _cross_validate(model_config, X, y, n_splits)
+
+    t1 = time.time()
+    trained = False
+    if evaluation_config["cv_mode"] != "cross_val_only":
+        model.fit(X, y)
+        trained = True
+    fit_elapsed = time.time() - t1
+
+    build_metadata = {
+        "name": name,
+        "gordo_components_tpu_version": __version__,
+        "checked_at": metadata_timestamp(),
+        "dataset": dataset.get_metadata(),
+        "model": {
+            "model_config": model_config,
+            "data_query_duration_sec": data_elapsed,
+            "model_training_duration_sec": fit_elapsed,
+            "trained": trained,
+            **(model.get_metadata() if hasattr(model, "get_metadata") else _pipeline_metadata(model)),
+        },
+        "user-defined": metadata,
+    }
+    if cv_meta:
+        build_metadata["model"]["cross-validation"] = cv_meta
+    return model, build_metadata
+
+
+def _pipeline_metadata(model) -> Dict[str, Any]:
+    """Metadata for sklearn Pipelines wrapping our estimators."""
+    if hasattr(model, "steps"):
+        final = model.steps[-1][1]
+        if hasattr(final, "get_metadata"):
+            return {"final_step": final.get_metadata()}
+    return {}
+
+
+def _cross_validate(model_config, X, y, n_splits: int) -> Dict[str, Any]:
+    """TimeSeriesSplit CV scoring explained variance per fold. Each fold
+    trains a fresh instance deserialized from config (sidestepping sklearn
+    ``clone`` constraints on captured-kwargs estimators)."""
+    from sklearn.model_selection import TimeSeriesSplit
+
+    Xv = X.values if hasattr(X, "values") else np.asarray(X)
+    yv = None if y is None else (y.values if hasattr(y, "values") else np.asarray(y))
+    scores = []
+    t0 = time.time()
+    for fold, (train_idx, test_idx) in enumerate(TimeSeriesSplit(n_splits=n_splits).split(Xv)):
+        fold_model = serializer.from_definition(model_config)
+        fold_model.fit(Xv[train_idx], None if yv is None else yv[train_idx])
+        score = fold_model.score(
+            Xv[test_idx], None if yv is None else yv[test_idx]
+        )
+        scores.append(float(score))
+        logger.info("CV fold %d explained variance: %.4f", fold, score)
+    return {
+        "cv_duration_sec": time.time() - t0,
+        "explained-variance": {
+            "mean": float(np.mean(scores)),
+            "std": float(np.std(scores)),
+            "per-fold": scores,
+        },
+    }
+
+
+def calculate_model_key(
+    name: str,
+    model_config: Dict[str, Any],
+    data_config: Dict[str, Any],
+    metadata: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Deterministic cache key over (name, configs, framework version)."""
+    payload = json.dumps(
+        {
+            "name": name,
+            "model_config": model_config,
+            "data_config": _jsonable_config(data_config),
+            "metadata": metadata or {},
+            "version": __version__,
+        },
+        sort_keys=True,
+        default=str,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _jsonable_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in config.items():
+        if hasattr(v, "to_dict"):
+            out[k] = v.to_dict()
+        elif isinstance(v, pd.Timestamp):
+            out[k] = v.isoformat()
+        else:
+            out[k] = v
+    return out
+
+
+def provide_saved_model(
+    name: str,
+    model_config: Dict[str, Any],
+    data_config: Dict[str, Any],
+    metadata: Optional[Dict[str, Any]] = None,
+    output_dir: str = "./model-output",
+    model_register_dir: Optional[str] = None,
+    replace_cache: bool = False,
+    evaluation_config: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Build-or-reuse: if a registered artifact exists for this config hash,
+    return it; else build, save to ``output_dir``, and register. Returns the
+    artifact directory path (reference semantics)."""
+    cache_key = calculate_model_key(name, model_config, data_config, metadata)
+
+    if model_register_dir and not replace_cache:
+        cached = os.path.join(model_register_dir, cache_key)
+        if os.path.isdir(cached) and os.path.exists(os.path.join(cached, "model.pkl")):
+            logger.info("Model %s found in build cache: %s", name, cached)
+            _mirror_artifact(cached, output_dir)
+            return cached
+
+    model, build_metadata = build_model(
+        name, model_config, data_config, metadata, evaluation_config
+    )
+    build_metadata["model"]["model_builder_cache_key"] = cache_key
+
+    dest = (
+        os.path.join(model_register_dir, cache_key)
+        if model_register_dir
+        else output_dir
+    )
+    serializer.dump(model, dest, metadata=build_metadata)
+    _mirror_artifact(dest, output_dir)
+    logger.info("Model %s built and saved to %s", name, dest)
+    return dest
+
+
+def _mirror_artifact(src_dir: str, output_dir: str) -> None:
+    """Surface a (possibly cached) registry artifact at the requested output
+    location — reruns must still populate the serving volume."""
+    if os.path.abspath(src_dir) == os.path.abspath(output_dir):
+        return
+    os.makedirs(output_dir, exist_ok=True)
+    for fname in os.listdir(src_dir):
+        src = os.path.join(src_dir, fname)
+        dst = os.path.join(output_dir, fname)
+        with open(src, "rb") as fs, open(dst, "wb") as fd:
+            fd.write(fs.read())

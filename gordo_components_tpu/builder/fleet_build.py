@@ -1,0 +1,227 @@
+"""Fleet builder: train a gang of machines in one process.
+
+This is the builder-pod entrypoint for gang-scheduled TPU jobs
+(workflow/scheduler.py): where the reference runs ``build_model`` once per
+pod, a gang job loads every member's dataset host-side, then trains all
+*fleetable* members in one vmap/shard_map program (parallel/fleet.py) and
+falls back to the per-machine ``provide_saved_model`` path for bespoke
+model configs — so arbitrary reference-style configs still work inside a
+gang.
+"""
+
+import copy
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.builder.build_model import (
+    _mirror_artifact,
+    calculate_model_key,
+    provide_saved_model,
+)
+from gordo_components_tpu.dataset import get_dataset
+from gordo_components_tpu.parallel.fleet import FleetTrainer
+from gordo_components_tpu.utils import metadata_timestamp
+from gordo_components_tpu.workflow.config import Machine
+
+logger = logging.getLogger(__name__)
+
+_AE_PATHS = (
+    "gordo_components_tpu.models.AutoEncoder",
+    "gordo_components_tpu.models.models.AutoEncoder",
+    "gordo_components.model.models.KerasAutoEncoder",
+)
+_DET_PATHS = (
+    "gordo_components_tpu.models.DiffBasedAnomalyDetector",
+    "gordo_components_tpu.models.anomaly.DiffBasedAnomalyDetector",
+    "gordo_components.model.anomaly.DiffBasedAnomalyDetector",
+)
+_SCALER_PATHS = (
+    "sklearn.preprocessing.MinMaxScaler",
+    "gordo_components_tpu.models.transformers.JaxMinMaxScaler",
+)
+
+
+def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """If ``model_config`` is EXACTLY the canonical anomaly pipeline —
+    ``DiffBasedAnomalyDetector(base_estimator=Pipeline(MinMaxScaler,
+    AutoEncoder))`` with no other detector kwargs — return the AutoEncoder
+    kwargs for FleetTrainer; else None (single-build path).
+
+    The check is deliberately strict: the fleet engine always min-max
+    scales inputs and builds a default detector, so any config that
+    deviates (extra detector kwargs, no scaler step, bare base estimator)
+    must take the single-build path to keep identical semantics.
+    """
+    if not isinstance(model_config, dict) or len(model_config) != 1:
+        return None
+    (path, kwargs), = model_config.items()
+    kwargs = kwargs or {}
+    if path not in _DET_PATHS:
+        return None
+    if set(kwargs) - {"base_estimator"}:
+        return None  # e.g. threshold_quantile/require_thresholds overrides
+    base = kwargs.get("base_estimator")
+    if not (isinstance(base, dict) and len(base) == 1):
+        return None
+    (bpath, bkwargs), = base.items()
+    if bpath != "sklearn.pipeline.Pipeline":
+        return None
+    steps = (bkwargs or {}).get("steps", [])
+    inner = []
+    for s in steps:
+        if isinstance(s, (list, tuple)) and len(s) == 2:
+            s = s[1]
+        inner.append(s)
+    if len(inner) == 2 and _is_path(inner[0], _SCALER_PATHS):
+        return _ae_kwargs(inner[1])
+    return None
+
+
+def _is_path(defn, paths) -> bool:
+    if isinstance(defn, str):
+        return defn in paths
+    if isinstance(defn, dict) and len(defn) == 1:
+        return next(iter(defn)) in paths
+    return False
+
+
+def _ae_kwargs(defn) -> Optional[Dict[str, Any]]:
+    if isinstance(defn, str):
+        return {} if defn in _AE_PATHS else None
+    if isinstance(defn, dict) and len(defn) == 1:
+        (path, kwargs), = defn.items()
+        if path in _AE_PATHS:
+            return dict(kwargs or {})
+    return None
+
+
+def _group_key(ae_kwargs: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, repr(v)) for k, v in ae_kwargs.items()))
+
+
+def build_fleet(
+    machines: List[Machine],
+    output_dir: str,
+    model_register_dir: Optional[str] = None,
+    replace_cache: bool = False,
+) -> Dict[str, str]:
+    """Build every machine; returns name -> artifact dir.
+
+    Fleetable machines with identical AutoEncoder kwargs train together in
+    one FleetTrainer program; everything else falls back to the single-model
+    builder. Cache semantics (config-hash keyed) apply to both paths.
+    """
+    results: Dict[str, str] = {}
+    fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
+
+    for machine in machines:
+        ae_kwargs = extract_fleetable(machine.model)
+        if ae_kwargs is None:
+            logger.info("Machine %s: bespoke config, single-build path", machine.name)
+            results[machine.name] = provide_saved_model(
+                machine.name,
+                machine.model,
+                machine.dataset,
+                machine.metadata,
+                output_dir=os.path.join(output_dir, machine.name),
+                model_register_dir=model_register_dir,
+                replace_cache=replace_cache,
+            )
+        else:
+            fleet_groups.setdefault(_group_key(ae_kwargs), []).append(
+                (machine, ae_kwargs)
+            )
+
+    for _, group in fleet_groups.items():
+        _build_fleet_group(
+            group, output_dir, model_register_dir, replace_cache, results
+        )
+    return results
+
+
+def _build_fleet_group(
+    group: List[Tuple[Machine, Dict[str, Any]]],
+    output_dir: str,
+    model_register_dir: Optional[str],
+    replace_cache: bool,
+    results: Dict[str, str],
+) -> None:
+    ae_kwargs = copy.deepcopy(group[0][1])
+
+    # cache check per machine first — reruns skip already-built members
+    pending: List[Machine] = []
+    for machine, _ in group:
+        key = calculate_model_key(machine.name, machine.model, machine.dataset, machine.metadata)
+        if model_register_dir and not replace_cache:
+            cached = os.path.join(model_register_dir, key)
+            if os.path.isdir(cached) and os.path.exists(os.path.join(cached, "model.pkl")):
+                logger.info("Machine %s: cache hit", machine.name)
+                _mirror_artifact(cached, os.path.join(output_dir, machine.name))
+                results[machine.name] = cached
+                continue
+        pending.append(machine)
+    if not pending:
+        return
+
+    # host-side data loading (the IO hot loop, SURVEY.md §3.1)
+    t0 = time.time()
+    member_data: Dict[str, np.ndarray] = {}
+    datasets_meta: Dict[str, Dict] = {}
+    for machine in pending:
+        ds = get_dataset(dict(machine.dataset))
+        X, _y = ds.get_data()
+        member_data[machine.name] = X  # DataFrame: trainer keeps tag names
+        datasets_meta[machine.name] = ds.get_metadata()
+    load_elapsed = time.time() - t0
+
+    trainer_kwargs = {
+        k: ae_kwargs.pop(k)
+        for k in (
+            "epochs", "batch_size", "learning_rate", "optimizer", "kind",
+            "early_stopping_patience", "early_stopping_min_delta", "seed",
+            "compute_dtype",
+        )
+        if k in ae_kwargs
+    }
+    trainer = FleetTrainer(**trainer_kwargs, **ae_kwargs)
+    t1 = time.time()
+    fleet_models = trainer.fit(member_data)
+    train_elapsed = time.time() - t1
+
+    by_name = {m.name: m for m in pending}
+    for name, fm in fleet_models.items():
+        machine = by_name[name]
+        det = fm.to_estimator()
+        key = calculate_model_key(machine.name, machine.model, machine.dataset, machine.metadata)
+        metadata = {
+            "name": name,
+            "checked_at": metadata_timestamp(),
+            "dataset": datasets_meta[name],
+            "model": {
+                "model_config": machine.model,
+                "fleet_trained": True,
+                "fleet_stats": trainer.last_stats,
+                "data_query_duration_sec": load_elapsed / max(1, len(pending)),
+                "model_training_duration_sec": train_elapsed / max(1, len(pending)),
+                "history": fm.history,
+                "model_builder_cache_key": key,
+                "trained": True,
+            },
+            "user-defined": machine.metadata,
+        }
+        dest = (
+            os.path.join(model_register_dir, key)
+            if model_register_dir
+            else os.path.join(output_dir, name)
+        )
+        serializer.dump(det, dest, metadata=metadata)
+        mirror = os.path.join(output_dir, name)
+        if os.path.abspath(mirror) != os.path.abspath(dest):
+            serializer.dump(det, mirror, metadata=metadata)
+        results[name] = dest
+        logger.info("Machine %s: fleet-built -> %s", name, dest)

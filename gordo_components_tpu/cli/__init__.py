@@ -1,0 +1,5 @@
+"""CLI (reference parity: gordo_components/cli/, unverified — SURVEY.md §2)."""
+
+from gordo_components_tpu.cli.cli import gordo
+
+__all__ = ["gordo"]

@@ -1,0 +1,269 @@
+"""``gordo-components-tpu`` command-line interface.
+
+Reference parity: the ``gordo-components`` click group
+(gordo_components/cli/cli.py, unverified; SURVEY.md §2 "cli"):
+``build`` (env-var driven builder-pod entrypoint with distinct exit codes),
+``run-server``, ``run-watchman``, ``client ...``, ``workflow generate`` —
+plus the TPU-native ``build-fleet`` gang entrypoint.
+"""
+
+import json
+import logging
+import os
+import sys
+
+import click
+import yaml
+
+logger = logging.getLogger(__name__)
+
+EXIT_OK = 0
+EXIT_CONFIG_ERROR = 81
+EXIT_DATA_ERROR = 82
+EXIT_BUILD_ERROR = 83
+
+
+@click.group("gordo-components-tpu")
+@click.option("--log-level", default="INFO", envvar="LOG_LEVEL")
+def gordo(log_level):
+    """TPU-native gordo: build, serve, and orchestrate fleets of
+    time-series anomaly-detection models."""
+    logging.basicConfig(
+        level=getattr(logging, log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+def _load_json_or_yaml(value: str):
+    try:
+        return json.loads(value)
+    except json.JSONDecodeError:
+        return yaml.safe_load(value)
+
+
+@gordo.command("build")
+@click.option("--name", envvar="MACHINE_NAME", required=True)
+@click.option("--model-config", envvar="MODEL_CONFIG", required=True,
+              help="JSON/YAML model definition (env MODEL_CONFIG)")
+@click.option("--data-config", envvar="DATA_CONFIG", required=True,
+              help="JSON/YAML dataset config (env DATA_CONFIG)")
+@click.option("--metadata", envvar="METADATA", default="{}")
+@click.option("--output-dir", envvar="OUTPUT_DIR", default="./model-output")
+@click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
+@click.option("--print-cv-scores", is_flag=True)
+def build(name, model_config, data_config, metadata, output_dir, model_register_dir, print_cv_scores):
+    """Build one model (builder-pod entrypoint; reference §3.1)."""
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.builder import provide_saved_model
+
+    try:
+        model_config = _load_json_or_yaml(model_config)
+        data_config = _load_json_or_yaml(data_config)
+        metadata = _load_json_or_yaml(metadata) or {}
+    except yaml.YAMLError as exc:
+        click.echo(f"Config parse error: {exc}", err=True)
+        sys.exit(EXIT_CONFIG_ERROR)
+
+    try:
+        path = provide_saved_model(
+            name, model_config, data_config, metadata,
+            output_dir=output_dir, model_register_dir=model_register_dir,
+        )
+    except (ValueError, ImportError, FileNotFoundError) as exc:
+        click.echo(f"Build failed (config/data): {exc}", err=True)
+        sys.exit(EXIT_DATA_ERROR)
+    except Exception as exc:
+        click.echo(f"Build failed: {exc}", err=True)
+        sys.exit(EXIT_BUILD_ERROR)
+
+    built_metadata = serializer.load_metadata(path)
+    if print_cv_scores:
+        cv = built_metadata.get("model", {}).get("cross-validation", {})
+        click.echo(json.dumps(cv.get("explained-variance", {})))
+    click.echo(path)
+
+
+@gordo.command("build-fleet")
+@click.option("--machines-file", envvar="MACHINES_FILE", required=True,
+              help="JSON/YAML file: gang payload or {machines: [...]}")
+@click.option("--output-dir", envvar="OUTPUT_DIR", default="./model-output")
+@click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
+def build_fleet_cmd(machines_file, output_dir, model_register_dir):
+    """Build a gang of machines in one process (TPU fleet engine)."""
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.workflow.config import Machine
+
+    with open(machines_file) as f:
+        payload = yaml.safe_load(f)
+    if isinstance(payload, dict):
+        entries = payload.get("machines", [])
+    elif isinstance(payload, list):
+        entries = payload
+    else:
+        entries = []
+    machines = []
+    for e in entries:
+        kwargs = dict(
+            name=e["name"],
+            dataset=e.get("dataset", {}),
+            metadata=e.get("metadata", {}) or {},
+        )
+        if e.get("model"):  # absent -> Machine's default model config
+            kwargs["model"] = e["model"]
+        machines.append(Machine(**kwargs))
+    if not machines:
+        click.echo("No machines in payload", err=True)
+        sys.exit(EXIT_CONFIG_ERROR)
+    try:
+        results = build_fleet(
+            machines, output_dir, model_register_dir=model_register_dir
+        )
+    except Exception as exc:
+        click.echo(f"Fleet build failed: {exc}", err=True)
+        sys.exit(EXIT_BUILD_ERROR)
+    click.echo(json.dumps(results, indent=2))
+
+
+@gordo.command("run-server")
+@click.option("--model-dir", envvar="MODEL_COLLECTION_DIR", required=True)
+@click.option("--host", default="0.0.0.0", envvar="SERVER_HOST")
+@click.option("--port", default=5555, envvar="SERVER_PORT", type=int)
+def run_server_cmd(model_dir, host, port):
+    """Serve the model collection under MODEL_COLLECTION_DIR."""
+    from gordo_components_tpu.server import run_server
+
+    run_server(model_dir, host=host, port=port)
+
+
+@gordo.command("run-watchman")
+@click.option("--project", envvar="PROJECT_NAME", required=True)
+@click.option("--server-base-url", envvar="SERVER_BASE_URL", required=True)
+@click.option("--targets", envvar="TARGET_NAMES", default=None,
+              help="JSON list; discovered from the server when omitted")
+@click.option("--host", default="0.0.0.0")
+@click.option("--port", default=5556, type=int)
+def run_watchman_cmd(project, server_base_url, targets, host, port):
+    """Fleet health aggregation service."""
+    from gordo_components_tpu.watchman import run_watchman
+
+    target_list = json.loads(targets) if targets else None
+    run_watchman(project, server_base_url, target_list, host=host, port=port)
+
+
+@gordo.group("client")
+def client_group():
+    """Bulk prediction client."""
+
+
+@client_group.command("predict")
+@click.argument("start")
+@click.argument("end")
+@click.option("--project", envvar="PROJECT_NAME", required=True)
+@click.option("--base-url", default="http://localhost:5555")
+@click.option("--target", multiple=True, help="Limit to specific machines")
+@click.option("--parquet-dir", default=None, help="Forward results to parquet files")
+@click.option("--batch-size", default=1000, type=int)
+def client_predict(start, end, project, base_url, target, parquet_dir, batch_size):
+    """Bulk anomaly scoring over a time range."""
+    import pandas as pd
+
+    from gordo_components_tpu.client import Client, ForwardPredictionsIntoParquet
+
+    forwarder = ForwardPredictionsIntoParquet(parquet_dir) if parquet_dir else None
+    client = Client(
+        project, base_url=base_url, forwarder=forwarder, batch_size=batch_size
+    )
+    results = client.predict(
+        pd.Timestamp(start), pd.Timestamp(end), targets=list(target) or None
+    )
+    ok = sum(1 for r in results if r.ok)
+    click.echo(f"{ok}/{len(results)} machines scored successfully")
+    for r in results:
+        if not r.ok:
+            click.echo(f"  FAILED {r.name}: {r.error_messages[:1]}", err=True)
+    if ok < len(results):
+        sys.exit(1)
+
+
+@client_group.command("metadata")
+@click.option("--project", envvar="PROJECT_NAME", required=True)
+@click.option("--base-url", default="http://localhost:5555")
+def client_metadata(project, base_url):
+    """Print every model's metadata as JSON."""
+    import asyncio
+
+    import aiohttp
+
+    from gordo_components_tpu.client.io import fetch_json
+
+    async def go():
+        async with aiohttp.ClientSession() as session:
+            targets = (
+                await fetch_json(session, f"{base_url}/gordo/v0/{project}/models")
+            )["models"]
+            out = {}
+            for t in targets:
+                body = await fetch_json(
+                    session, f"{base_url}/gordo/v0/{project}/{t}/metadata"
+                )
+                out[t] = body.get("endpoint-metadata", {})
+            return out
+
+    click.echo(json.dumps(asyncio.run(go()), indent=2, default=str))
+
+
+@client_group.command("download-model")
+@click.argument("target")
+@click.argument("dest", type=click.Path())
+@click.option("--project", envvar="PROJECT_NAME", required=True)
+@click.option("--base-url", default="http://localhost:5555")
+def client_download_model(target, dest, project, base_url):
+    """Download a model artifact as a pickle file."""
+    import requests
+
+    resp = requests.get(
+        f"{base_url}/gordo/v0/{project}/{target}/download-model", timeout=120
+    )
+    resp.raise_for_status()
+    with open(dest, "wb") as f:
+        f.write(resp.content)
+    click.echo(dest)
+
+
+@gordo.group("workflow")
+def workflow_group():
+    """Workflow generation."""
+
+
+@workflow_group.command("generate")
+@click.option("--machine-config", "-f", required=True, type=click.Path(exists=True))
+@click.option("--project-name", "-p", required=True)
+@click.option("--output-file", "-o", default=None, type=click.Path())
+@click.option("--models-per-gang", default=None, type=int)
+@click.option("--devices-per-gang", default=None, type=int)
+def workflow_generate(machine_config, project_name, output_file, models_per_gang, devices_per_gang):
+    """Render gang-scheduled TPU manifests from a fleet config
+    (reference §3.4)."""
+    from gordo_components_tpu.workflow import NormalizedConfig, generate_workflow
+
+    try:
+        config = NormalizedConfig.from_yaml_file(machine_config)
+    except (ValueError, yaml.YAMLError) as exc:
+        click.echo(f"Invalid machine config: {exc}", err=True)
+        sys.exit(EXIT_CONFIG_ERROR)
+    overrides = {}
+    if models_per_gang:
+        overrides["models_per_gang"] = models_per_gang
+    if devices_per_gang:
+        overrides["devices_per_gang"] = devices_per_gang
+    manifest = generate_workflow(config, project_name, **overrides)
+    if output_file:
+        with open(output_file, "w") as f:
+            f.write(manifest)
+        click.echo(output_file)
+    else:
+        click.echo(manifest)
+
+
+if __name__ == "__main__":
+    gordo()

@@ -1,0 +1,15 @@
+"""Bulk-prediction client (reference parity: gordo_components/client/,
+unverified — SURVEY.md §2)."""
+
+from gordo_components_tpu.client.client import Client, PredictionResult
+from gordo_components_tpu.client.forwarders import (
+    ForwardPredictionsIntoInflux,
+    ForwardPredictionsIntoParquet,
+)
+
+__all__ = [
+    "Client",
+    "PredictionResult",
+    "ForwardPredictionsIntoInflux",
+    "ForwardPredictionsIntoParquet",
+]

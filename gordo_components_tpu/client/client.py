@@ -1,0 +1,180 @@
+"""Bulk prediction client.
+
+Reference parity: ``Client`` (gordo_components/client/client.py, unverified;
+SURVEY.md §2 "client", §3.3): discover the project's endpoints (watchman or
+the server's collection listing), rebuild each machine's dataset config from
+metadata, chunk the requested time range, POST batches with bounded
+concurrency (the THROUGHPUT HOT LOOP), and optionally forward results to a
+prediction store.
+"""
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+import pandas as pd
+
+from gordo_components_tpu.client.io import fetch_json
+from gordo_components_tpu.dataset import get_dataset
+from gordo_components_tpu.server.utils import dict_to_frame
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PredictionResult:
+    """Per-machine outcome of a bulk run (reference: ``PredictionResult``)."""
+
+    name: str
+    predictions: Optional[pd.DataFrame]
+    error_messages: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.predictions is not None and not self.error_messages
+
+
+class Client:
+    """Score time ranges against every model of a project."""
+
+    def __init__(
+        self,
+        project: str,
+        host: str = "localhost",
+        port: int = 5555,
+        scheme: str = "http",
+        *,
+        base_url: Optional[str] = None,
+        batch_size: int = 1000,
+        parallelism: int = 10,
+        forwarder=None,
+        use_anomaly: bool = True,
+        metadata_fallback_dataset: Optional[Dict[str, Any]] = None,
+    ):
+        self.project = project
+        self.base_url = base_url or f"{scheme}://{host}:{port}"
+        self.batch_size = int(batch_size)
+        self.parallelism = int(parallelism)
+        self.forwarder = forwarder
+        self.use_anomaly = use_anomaly
+        self.metadata_fallback_dataset = metadata_fallback_dataset
+
+    # ------------------------------------------------------------------ #
+
+    def _url(self, target: str, endpoint: str) -> str:
+        return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
+
+    async def _get_targets(self, session) -> List[str]:
+        body = await fetch_json(
+            session, f"{self.base_url}/gordo/v0/{self.project}/models"
+        )
+        return body["models"]
+
+    async def _get_metadata(self, session, target: str) -> Dict[str, Any]:
+        body = await fetch_json(session, self._url(target, "metadata"))
+        return body.get("endpoint-metadata", {})
+
+    def _dataset_config_from_metadata(self, meta, start, end) -> Dict[str, Any]:
+        ds_meta = meta.get("dataset", {})
+        config = self.metadata_fallback_dataset or {"type": "RandomDataset"}
+        if ds_meta:
+            config = {
+                "type": ds_meta.get("type", "TimeSeriesDataset"),
+                "tag_list": [t["name"] for t in ds_meta.get("tag_list", [])],
+                "resolution": ds_meta.get("resolution", "10min"),
+                "data_provider": ds_meta.get("data_provider"),
+            }
+            if isinstance(config["data_provider"], dict):
+                # provider dict re-instantiated by the dataset layer
+                pass
+            else:
+                config.pop("data_provider", None)
+        return {
+            **config,
+            "train_start_date": str(start),
+            "train_end_date": str(end),
+        }
+
+    # ------------------------------------------------------------------ #
+
+    def predict(
+        self, start: pd.Timestamp, end: pd.Timestamp, targets: Optional[List[str]] = None
+    ) -> List[PredictionResult]:
+        """Synchronous entrypoint (reference CLI semantics)."""
+        return asyncio.run(self.predict_async(start, end, targets))
+
+    async def predict_async(
+        self, start, end, targets: Optional[List[str]] = None
+    ) -> List[PredictionResult]:
+        timeout = aiohttp.ClientTimeout(total=600)
+        sem = asyncio.Semaphore(self.parallelism)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            if targets is None:
+                targets = await self._get_targets(session)
+            results = await asyncio.gather(
+                *(
+                    self._predict_single(session, sem, t, start, end)
+                    for t in targets
+                )
+            )
+        if self.forwarder is not None:
+            for result in results:
+                if result.ok:
+                    self.forwarder.forward(result)
+        return list(results)
+
+    async def _predict_single(
+        self, session, sem, target: str, start, end
+    ) -> PredictionResult:
+        try:
+            meta = await self._get_metadata(session, target)
+            config = self._dataset_config_from_metadata(meta, start, end)
+            dataset = get_dataset(config)
+            X, y = await asyncio.get_running_loop().run_in_executor(
+                None, dataset.get_data
+            )
+        except Exception as exc:
+            logger.exception("Failed to build dataset for %s", target)
+            return PredictionResult(target, None, [f"dataset: {exc}"])
+
+        endpoint = "anomaly/prediction" if self.use_anomaly else "prediction"
+        frames: List[pd.DataFrame] = []
+        errors: List[str] = []
+
+        async def post_chunk(chunk: pd.DataFrame):
+            payload = {
+                "X": chunk.values.tolist(),
+                "index": [str(i) for i in chunk.index],
+            }
+            async with sem:
+                try:
+                    body = await fetch_json(
+                        session,
+                        self._url(target, endpoint),
+                        method="POST",
+                        json_payload=payload,
+                    )
+                except Exception as exc:
+                    errors.append(f"chunk {chunk.index[0]}: {exc}")
+                    return None
+                return body
+
+        chunks = [
+            X.iloc[i : i + self.batch_size]
+            for i in range(0, len(X), self.batch_size)
+        ]
+        bodies = await asyncio.gather(*(post_chunk(c) for c in chunks))
+        for body in bodies:
+            if body is None:
+                continue
+            if "data" in body and isinstance(body["data"], dict):
+                frames.append(dict_to_frame(body))
+            elif "data" in body:
+                df = pd.DataFrame(body["data"])
+                if body.get("index") and len(body["index"]) == len(df):
+                    df.index = pd.to_datetime(body["index"], utc=True)
+                frames.append(df)
+        predictions = pd.concat(frames) if frames else None
+        return PredictionResult(target, predictions, errors)

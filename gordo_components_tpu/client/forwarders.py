@@ -1,0 +1,76 @@
+"""Prediction forwarders.
+
+Reference parity: ``ForwardPredictionsIntoInflux``
+(gordo_components/client/forwarders.py, unverified; SURVEY.md §2 "client")
+— write prediction/anomaly frames back to a store. The InfluxDB wire client
+is not in this image, so the Influx forwarder accepts an injected client;
+a filesystem (parquet) forwarder is provided as the batteries-included
+store for TPU-pod-local runs.
+"""
+
+import logging
+import os
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ForwardPredictionsIntoInflux:
+    """Write each machine's prediction frame as InfluxDB points.
+
+    ``client``: injected object with ``write_points(points, ...)``
+    (e.g. ``influxdb.InfluxDBClient``); required since the influxdb package
+    is unavailable here.
+    """
+
+    def __init__(
+        self,
+        client: Any = None,
+        destination_measurement: str = "predictions",
+        value_name: str = "value",
+    ):
+        if client is None:
+            raise ValueError(
+                "InfluxDB client package unavailable — pass client= (object "
+                "with write_points)."
+            )
+        self.client = client
+        self.destination_measurement = destination_measurement
+        self.value_name = value_name
+
+    def forward(self, result) -> None:
+        df = result.predictions
+        points = []
+        for ts, row in df.iterrows():
+            for col, value in row.items():
+                field = "|".join(c for c in col if c) if isinstance(col, tuple) else str(col)
+                points.append(
+                    {
+                        "measurement": self.destination_measurement,
+                        "tags": {"machine": result.name, "field": field},
+                        "time": str(ts),
+                        "fields": {self.value_name: float(value)},
+                    }
+                )
+        logger.info("Forwarding %d points for %s to influx", len(points), result.name)
+        self.client.write_points(points)
+
+
+class ForwardPredictionsIntoParquet:
+    """Write each machine's prediction frame to
+    ``<root>/<machine>.parquet`` (TPU-native default store)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def forward(self, result) -> None:
+        path = os.path.join(self.root, f"{result.name}.parquet")
+        df = result.predictions.copy()
+        if hasattr(df.columns, "to_flat_index"):
+            df.columns = [
+                "|".join(c for c in col if c) if isinstance(col, tuple) else str(col)
+                for col in df.columns.to_flat_index()
+            ]
+        df.to_parquet(path)
+        logger.info("Wrote predictions for %s -> %s", result.name, path)

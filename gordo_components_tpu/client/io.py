@@ -1,0 +1,57 @@
+"""Async HTTP helpers for the bulk client.
+
+Reference parity: gordo_components/client/io.py (unverified; SURVEY.md §2
+"client") — bounded-concurrency POSTs with retry/backoff.
+"""
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+
+class HttpUnprocessableEntity(Exception):
+    """422 — the endpoint exists but rejected the payload (no point
+    retrying)."""
+
+
+async def fetch_json(
+    session: aiohttp.ClientSession,
+    url: str,
+    *,
+    method: str = "GET",
+    json_payload: Optional[Dict[str, Any]] = None,
+    retries: int = 3,
+    backoff: float = 0.5,
+) -> Dict[str, Any]:
+    """GET/POST returning parsed JSON, with bounded retry on transient
+    failures; 4xx (except 408/429) are not retried."""
+    last_exc: Optional[Exception] = None
+    for attempt in range(retries):
+        try:
+            async with session.request(method, url, json=json_payload) as resp:
+                if resp.status == 422:
+                    raise HttpUnprocessableEntity(await resp.text())
+                if resp.status in (408, 429) or resp.status >= 500:
+                    raise aiohttp.ClientResponseError(
+                        resp.request_info,
+                        resp.history,
+                        status=resp.status,
+                        message=await resp.text(),
+                    )
+                if resp.status >= 400:
+                    body = await resp.text()
+                    raise ValueError(f"HTTP {resp.status} from {url}: {body[:500]}")
+                return await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            last_exc = exc
+            delay = backoff * (2**attempt)
+            logger.warning(
+                "Request %s %s failed (%s); retry %d/%d in %.1fs",
+                method, url, exc, attempt + 1, retries, delay,
+            )
+            await asyncio.sleep(delay)
+    raise last_exc  # type: ignore[misc]

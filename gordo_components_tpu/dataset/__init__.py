@@ -1,0 +1,27 @@
+"""Dataset layer (reference parity: gordo_components/dataset/, unverified —
+SURVEY.md §2)."""
+
+from gordo_components_tpu.dataset.base import GordoBaseDataset, get_dataset
+from gordo_components_tpu.dataset.datasets import (
+    RandomDataset,
+    TimeSeriesDataset,
+    join_timeseries,
+)
+from gordo_components_tpu.dataset.sensor_tag import (
+    SensorTag,
+    normalize_sensor_tag,
+    normalize_sensor_tags,
+)
+from gordo_components_tpu.dataset.filter_rows import pandas_filter_rows
+
+__all__ = [
+    "GordoBaseDataset",
+    "get_dataset",
+    "TimeSeriesDataset",
+    "RandomDataset",
+    "join_timeseries",
+    "SensorTag",
+    "normalize_sensor_tag",
+    "normalize_sensor_tags",
+    "pandas_filter_rows",
+]

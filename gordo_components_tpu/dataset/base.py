@@ -1,0 +1,41 @@
+"""Dataset contract + config factory.
+
+Reference parity: ``GordoBaseDataset`` and ``dataset.get_dataset(config)``
+(gordo_components/dataset/, unverified; SURVEY.md §2, §3.1).
+"""
+
+import abc
+from typing import Any, Dict, Optional, Tuple
+
+import pandas as pd
+
+
+class GordoBaseDataset(abc.ABC):
+    @abc.abstractmethod
+    def get_data(self) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+        """Returns ``(X, y)``; y is None for pure-autoencoder datasets."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> Dict[str, Any]:
+        """JSON-serializable description of the dataset (tag list, ranges,
+        filtering, resolution, row counts) for the build-metadata contract."""
+
+
+def get_dataset(config: Dict[str, Any]) -> GordoBaseDataset:
+    """Build a dataset from a data config dict. ``type`` selects the class
+    (short name within this package or dotted path); remaining keys are
+    constructor kwargs — matching the reference's ``data_config`` handling."""
+    from gordo_components_tpu.dataset import datasets
+
+    config = dict(config)
+    kind = config.pop("type", "TimeSeriesDataset")
+    if "." in kind:
+        from gordo_components_tpu.serializer.definitions import import_locate
+
+        cls = import_locate(kind)
+    else:
+        try:
+            cls = getattr(datasets, kind)
+        except AttributeError:
+            raise ValueError(f"Unknown dataset type {kind!r}")
+    return cls(**config)

@@ -1,0 +1,16 @@
+"""Data providers (reference parity: gordo_components/dataset/data_provider/,
+unverified — SURVEY.md §2)."""
+
+from gordo_components_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_components_tpu.dataset.data_provider.providers import (
+    FileSystemProvider,
+    InfluxDataProvider,
+    RandomDataProvider,
+)
+
+__all__ = [
+    "GordoBaseDataProvider",
+    "RandomDataProvider",
+    "InfluxDataProvider",
+    "FileSystemProvider",
+]

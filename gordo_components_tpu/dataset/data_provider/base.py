@@ -1,0 +1,51 @@
+"""Data-provider contract.
+
+Reference parity: ``GordoBaseDataProvider`` (gordo_components/dataset/
+data_provider/base.py, unverified; SURVEY.md §2 "dataset.data_provider") —
+providers stream one ``pd.Series`` per sensor tag for a time range, declare
+``can_handle_tag``, and serialize themselves into metadata via
+``capture_args``.
+"""
+
+import abc
+from typing import Iterable, List, Optional
+
+import pandas as pd
+
+from gordo_components_tpu.dataset.sensor_tag import SensorTag
+
+
+class GordoBaseDataProvider(abc.ABC):
+    @abc.abstractmethod
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        """Yield one datetime-indexed Series per tag (named after the tag)."""
+
+    @abc.abstractmethod
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        """Whether this provider knows how to read the given tag."""
+
+    def to_dict(self) -> dict:
+        """Serialize into metadata/config form (capture_args contract)."""
+        cls = type(self)
+        return {
+            "type": f"{cls.__module__}.{cls.__qualname__}",
+            **{k: _jsonable(v) for k, v in getattr(self, "_params", {}).items()},
+        }
+
+
+def _jsonable(v):
+    if isinstance(v, pd.Timestamp):
+        return v.isoformat()
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)

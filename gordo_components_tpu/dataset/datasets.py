@@ -1,0 +1,193 @@
+"""Concrete datasets.
+
+Reference parity: ``TimeSeriesDataset`` / ``RandomDataset`` / ``join_timeseries``
+(gordo_components/dataset/datasets.py, unverified; SURVEY.md §2 "dataset",
+§3.1 "the IO HOT LOOP"): pull per-tag series from a provider, resample each
+to ``resolution`` (mean aggregation), outer-join on timestamp, dropna,
+apply ``row_filter``; X = tag columns, y = ``target_tag_list`` columns when
+given.
+"""
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import pandas as pd
+
+from gordo_components_tpu.dataset.base import GordoBaseDataset
+from gordo_components_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_components_tpu.dataset.data_provider.providers import RandomDataProvider
+from gordo_components_tpu.dataset.filter_rows import pandas_filter_rows
+from gordo_components_tpu.dataset.sensor_tag import (
+    SensorTag,
+    normalize_sensor_tags,
+)
+from gordo_components_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+def _normalize_resolution(resolution: str) -> str:
+    """Accept reference-era pandas offsets ('10T') alongside modern ones
+    ('10min')."""
+    if resolution and resolution[-1] == "T" and resolution[:-1].isdigit():
+        return resolution[:-1] + "min"
+    return resolution
+
+
+def join_timeseries(
+    series_list: List[pd.Series],
+    resampling_start: pd.Timestamp,
+    resampling_end: pd.Timestamp,
+    resolution: str,
+    aggregation: str = "mean",
+) -> Tuple[pd.DataFrame, Dict[str, Any]]:
+    """Resample each tag series to ``resolution`` then outer-join on the
+    timestamp index; returns the joined frame + per-tag row metadata."""
+    resolution = _normalize_resolution(resolution)
+    resampled = []
+    meta: Dict[str, Any] = {}
+    for series in series_list:
+        name = series.name
+        meta[str(name)] = {"rows_raw": int(series.size)}
+        if series.empty:
+            resampled.append(series)
+            continue
+        r = (
+            series[(series.index >= resampling_start) & (series.index < resampling_end)]
+            .resample(resolution)
+            .agg(aggregation)
+        )
+        meta[str(name)]["rows_resampled"] = int(r.size)
+        resampled.append(r)
+    df = pd.concat(resampled, axis=1, join="outer")
+    return df, meta
+
+
+class TimeSeriesDataset(GordoBaseDataset):
+    """Provider-backed multi-tag time-series dataset."""
+
+    @capture_args
+    def __init__(
+        self,
+        train_start_date: Union[str, pd.Timestamp],
+        train_end_date: Union[str, pd.Timestamp],
+        tag_list: List,
+        target_tag_list: Optional[List] = None,
+        data_provider: Union[GordoBaseDataProvider, Dict, None] = None,
+        resolution: str = "10min",
+        aggregation_method: str = "mean",
+        row_filter: str = "",
+        asset: Optional[str] = None,
+    ):
+        self.train_start_date = pd.Timestamp(train_start_date)
+        self.train_end_date = pd.Timestamp(train_end_date)
+        if self.train_start_date.tzinfo is None:
+            self.train_start_date = self.train_start_date.tz_localize("UTC")
+        if self.train_end_date.tzinfo is None:
+            self.train_end_date = self.train_end_date.tz_localize("UTC")
+        if self.train_start_date >= self.train_end_date:
+            raise ValueError("train_start_date must precede train_end_date")
+        self.tag_list = normalize_sensor_tags(tag_list, asset)
+        self.target_tag_list = (
+            normalize_sensor_tags(target_tag_list, asset) if target_tag_list else []
+        )
+        if data_provider is None:
+            data_provider = RandomDataProvider()
+        elif isinstance(data_provider, dict):
+            data_provider = _provider_from_dict(data_provider)
+        self.data_provider = data_provider
+        self.resolution = _normalize_resolution(resolution)
+        self.aggregation_method = aggregation_method
+        self.row_filter = row_filter
+        self._last_metadata: Dict[str, Any] = {}
+
+    def get_data(self) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+        tags = list(self.tag_list)
+        extra_targets = [t for t in self.target_tag_list if t not in tags]
+        series = list(
+            self.data_provider.load_series(
+                self.train_start_date, self.train_end_date, tags + extra_targets
+            )
+        )
+        df, tag_meta = join_timeseries(
+            series,
+            self.train_start_date,
+            self.train_end_date,
+            self.resolution,
+            self.aggregation_method,
+        )
+        rows_joined = len(df)
+        df = df.dropna()
+        rows_dropna = len(df)
+        if self.row_filter:
+            df = pandas_filter_rows(df, self.row_filter)
+        self._last_metadata = {
+            "tag_loading": tag_meta,
+            "rows_joined": rows_joined,
+            "rows_after_dropna": rows_dropna,
+            "rows_after_filter": len(df),
+        }
+        X = df[[t.name for t in self.tag_list]]
+        y = (
+            df[[t.name for t in self.target_tag_list]]
+            if self.target_tag_list
+            else None
+        )
+        return X, y
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return {
+            "type": type(self).__name__,
+            "train_start_date": self.train_start_date.isoformat(),
+            "train_end_date": self.train_end_date.isoformat(),
+            "tag_list": [t._asdict() for t in self.tag_list],
+            "target_tag_list": [t._asdict() for t in self.target_tag_list],
+            "resolution": self.resolution,
+            "aggregation_method": self.aggregation_method,
+            "row_filter": self.row_filter,
+            "data_provider": (
+                self.data_provider.to_dict()
+                if hasattr(self.data_provider, "to_dict")
+                else repr(self.data_provider)
+            ),
+            **self._last_metadata,
+        }
+
+
+class RandomDataset(TimeSeriesDataset):
+    """TimeSeriesDataset over deterministic synthetic data (reference:
+    ``RandomDataset`` [H]); the default fake backend for tests/benchmarks."""
+
+    @capture_args
+    def __init__(
+        self,
+        train_start_date: Union[str, pd.Timestamp] = "2017-12-25 06:00:00Z",
+        train_end_date: Union[str, pd.Timestamp] = "2017-12-29 06:00:00Z",
+        tag_list: Optional[List] = None,
+        **kwargs,
+    ):
+        tag_list = tag_list or [f"tag-{i}" for i in range(10)]
+        kwargs.setdefault("data_provider", RandomDataProvider())
+        super().__init__(
+            train_start_date=train_start_date,
+            train_end_date=train_end_date,
+            tag_list=tag_list,
+            **kwargs,
+        )
+        self._params = {
+            "train_start_date": str(train_start_date),
+            "train_end_date": str(train_end_date),
+            "tag_list": tag_list,
+            **{k: v for k, v in kwargs.items() if k != "data_provider"},
+        }
+
+
+def _provider_from_dict(config: Dict[str, Any]) -> GordoBaseDataProvider:
+    """Inverse of ``GordoBaseDataProvider.to_dict``."""
+    from gordo_components_tpu.dataset import data_provider as dp_module
+    from gordo_components_tpu.serializer.definitions import import_locate
+
+    config = dict(config)
+    kind = config.pop("type", "RandomDataProvider")
+    cls = import_locate(kind) if "." in kind else getattr(dp_module, kind)
+    return cls(**config)

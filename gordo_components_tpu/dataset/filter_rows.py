@@ -1,0 +1,59 @@
+"""Safe row filtering with pandas expressions.
+
+Reference parity: ``pandas_filter_rows`` (gordo_components/dataset/
+filter_rows.py, unverified; SURVEY.md §2 "dataset") — user configs carry
+filter expressions like ``"`TAG-1` > 0 & `TAG-2` < 100"``; they are parsed
+and AST-whitelisted before evaluation so config files cannot execute
+arbitrary code.
+"""
+
+import ast
+import logging
+import re
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+_ALLOWED_NODES = (
+    ast.Expression,
+    ast.BoolOp, ast.And, ast.Or,
+    ast.UnaryOp, ast.Not, ast.USub, ast.UAdd, ast.Invert,
+    ast.BinOp, ast.Add, ast.Sub, ast.Mult, ast.Div, ast.Mod, ast.Pow,
+    ast.BitAnd, ast.BitOr,
+    ast.Compare, ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+    ast.Name, ast.Load, ast.Constant, ast.Tuple, ast.List, ast.Call,
+)
+
+_ALLOWED_CALLS = {"abs"}
+
+
+def _check_expression(expr: str) -> None:
+    # pandas backtick-quoted names (`TAG-1`) aren't python-parsable; replace
+    # each whole quoted segment with a plain identifier for the safety check
+    # only (evaluation still uses the original string)
+    cleaned = re.sub(r"`[^`]*`", "_col_", expr)
+    cleaned = cleaned.replace("&", " and ").replace("|", " or ")
+    try:
+        tree = ast.parse(cleaned, mode="eval")
+    except SyntaxError as exc:
+        raise ValueError(f"Cannot parse row_filter expression {expr!r}: {exc}")
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ValueError(
+                f"Disallowed construct {type(node).__name__} in row_filter {expr!r}"
+            )
+        if isinstance(node, ast.Call):
+            if not (isinstance(node.func, ast.Name) and node.func.id in _ALLOWED_CALLS):
+                raise ValueError(f"Disallowed call in row_filter {expr!r}")
+
+
+def pandas_filter_rows(df: pd.DataFrame, filter_str: str) -> pd.DataFrame:
+    """Filter rows of ``df`` by a whitelisted pandas query expression."""
+    if not isinstance(filter_str, str) or not filter_str.strip():
+        return df
+    _check_expression(filter_str)
+    mask = df.eval(filter_str)
+    out = df[mask]
+    logger.info("row_filter %r kept %d/%d rows", filter_str, len(out), len(df))
+    return out

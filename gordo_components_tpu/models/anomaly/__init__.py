@@ -1,0 +1,7 @@
+"""Anomaly-scoring wrappers (reference parity: gordo_components/model/anomaly/,
+unverified — SURVEY.md §2 "model.anomaly")."""
+
+from gordo_components_tpu.models.anomaly.base import AnomalyDetectorBase
+from gordo_components_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+
+__all__ = ["AnomalyDetectorBase", "DiffBasedAnomalyDetector"]

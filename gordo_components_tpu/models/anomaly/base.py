@@ -1,0 +1,18 @@
+"""Abstract anomaly-detector contract (reference:
+gordo_components/model/anomaly/base.py, unverified; SURVEY.md §2)."""
+
+import abc
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from gordo_components_tpu.models.base import GordoBase
+
+
+class AnomalyDetectorBase(GordoBase, abc.ABC):
+    @abc.abstractmethod
+    def anomaly(self, X, y=None) -> pd.DataFrame:
+        """Score X, returning the multi-level anomaly frame served by
+        ``POST /anomaly/prediction``: per-tag scaled/unscaled anomalies and
+        total-anomaly columns alongside model input/output."""

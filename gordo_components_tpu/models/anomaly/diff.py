@@ -1,0 +1,194 @@
+"""Reconstruction-error anomaly detector.
+
+Reference parity: ``DiffBasedAnomalyDetector`` in
+gordo_components/model/anomaly/diff.py (unverified; SURVEY.md §2
+"model.anomaly" — named explicitly in BASELINE.json): wraps a base
+pipeline/estimator; fit learns a per-feature scaling of the reconstruction
+error; ``anomaly(X)`` returns a multi-level DataFrame with model-input,
+model-output, per-tag anomaly (scaled + unscaled), and total-anomaly
+columns; cross-validated thresholds land in metadata.
+
+TPU-native notes: the scoring math (diff, per-feature error scaling, norms)
+is a single jit'd program (``_score_fn``) over float32 device arrays — this
+is the server's per-request hot loop (SURVEY.md §3.2) — with the pandas
+frame assembled host-side only at the edge.
+"""
+
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from gordo_components_tpu.models.anomaly.base import AnomalyDetectorBase
+from gordo_components_tpu.models.base import GordoBase
+from gordo_components_tpu.ops.scaler import (
+    ScalerParams,
+    fit_minmax,
+    scaler_transform,
+)
+from gordo_components_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def _score_fn(err_scale: ScalerParams, target: jnp.ndarray, output: jnp.ndarray):
+    """diff -> (abs diff, scaled abs diff, total norms). One XLA program."""
+    diff = jnp.abs(target - output)
+    scaled = scaler_transform(err_scale, diff)
+    total_unscaled = jnp.linalg.norm(diff, axis=-1)
+    total_scaled = jnp.linalg.norm(scaled, axis=-1)
+    return diff, scaled, total_unscaled, total_scaled
+
+
+class DiffBasedAnomalyDetector(AnomalyDetectorBase):
+    """Anomaly = norm of (per-feature scaled) |y - reconstruction|."""
+
+    @capture_args
+    def __init__(
+        self,
+        base_estimator: Optional[GordoBase] = None,
+        require_thresholds: bool = False,
+        threshold_quantile: float = 1.0,
+    ):
+        # default mirrors the reference's default model: hourglass AE
+        if base_estimator is None:
+            from gordo_components_tpu.models.models import AutoEncoder
+
+            base_estimator = AutoEncoder(kind="feedforward_hourglass")
+        self.base_estimator = base_estimator
+        self.require_thresholds = require_thresholds
+        self.threshold_quantile = float(threshold_quantile)
+        self.error_scaler_: Optional[ScalerParams] = None
+        self.feature_thresholds_: Optional[np.ndarray] = None
+        self.total_threshold_: Optional[float] = None
+        self.tags_: Optional[list] = None
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def _offset(self) -> int:
+        """Rows consumed by sequence warm-up: output row i corresponds to
+        input row i + offset (0 for feedforward)."""
+        est = self._final_estimator
+        return getattr(est, "lookback_window", 1) - 1 + getattr(est, "_target_offset", 0)
+
+    @property
+    def _final_estimator(self):
+        est = self.base_estimator
+        if hasattr(est, "steps"):  # sklearn Pipeline
+            return est.steps[-1][1]
+        return est
+
+    def _model_space(self, X: np.ndarray) -> np.ndarray:
+        """Map raw values through the pipeline's pre-model transformers so the
+        diff is computed in the same space the model reconstructs."""
+        est = self.base_estimator
+        if hasattr(est, "steps"):
+            for _, step in est.steps[:-1]:
+                X = step.transform(X)
+        return np.asarray(X, dtype=np.float32)
+
+    def _predict_model_space(self, X: np.ndarray) -> np.ndarray:
+        est = self.base_estimator
+        if hasattr(est, "steps"):
+            for _, step in est.steps[:-1]:
+                X = step.transform(X)
+            return np.asarray(est.steps[-1][1].predict(X), dtype=np.float32)
+        return np.asarray(est.predict(X), dtype=np.float32)
+
+    # ------------------------------------------------------------------ #
+
+    def fit(self, X, y=None, **kwargs):
+        if isinstance(X, pd.DataFrame):
+            self.tags_ = [str(c) for c in X.columns]
+            Xv = X.values.astype(np.float32)
+        else:
+            Xv = np.asarray(X, dtype=np.float32)
+            self.tags_ = [f"feature-{i}" for i in range(Xv.shape[-1])]
+
+        self.base_estimator.fit(Xv, y)
+
+        # per-feature error scaling learned from the training residuals
+        output = self._predict_model_space(Xv)
+        target = self._model_space(Xv if y is None else np.asarray(y, np.float32))
+        target = target[self._offset :][: output.shape[0]]
+        diff = np.abs(target - output)
+        self.error_scaler_ = jax.tree.map(np.asarray, fit_minmax(jnp.asarray(diff)))
+
+        # thresholds: quantile of training scaled errors (the builder's
+        # cross-validation path refines these across folds)
+        scaled = np.asarray(
+            scaler_transform(ScalerParams(*self.error_scaler_), jnp.asarray(diff))
+        )
+        q = self.threshold_quantile
+        self.feature_thresholds_ = np.quantile(scaled, q, axis=0)
+        self.total_threshold_ = float(
+            np.quantile(np.linalg.norm(scaled, axis=-1), q)
+        )
+        return self
+
+    def predict(self, X):
+        return self.base_estimator.predict(X)
+
+    def score(self, X, y=None) -> float:
+        return self.base_estimator.score(X, y)
+
+    def _check_fitted(self):
+        if self.error_scaler_ is None:
+            raise RuntimeError("DiffBasedAnomalyDetector has not been fitted")
+        if self.require_thresholds and self.total_threshold_ is None:
+            raise RuntimeError("Thresholds required but not computed")
+
+    def anomaly(self, X, y=None) -> pd.DataFrame:
+        """Multi-level anomaly frame (reference column scheme):
+        ``model-input``, ``model-output``, ``tag-anomaly-unscaled``,
+        ``tag-anomaly-scaled``, ``total-anomaly-unscaled``,
+        ``total-anomaly-scaled``."""
+        self._check_fitted()
+        index = X.index[self._offset :] if isinstance(X, pd.DataFrame) else None
+        Xv = X.values.astype(np.float32) if isinstance(X, pd.DataFrame) else np.asarray(X, np.float32)
+        tags = self.tags_ or [f"feature-{i}" for i in range(Xv.shape[-1])]
+
+        output = self._predict_model_space(Xv)
+        yv = Xv if y is None else (y.values if isinstance(y, pd.DataFrame) else np.asarray(y))
+        target = self._model_space(np.asarray(yv, np.float32))
+        target = target[self._offset :][: output.shape[0]]
+        inp = Xv[self._offset :][: output.shape[0]]
+        if index is not None:
+            index = index[: output.shape[0]]
+
+        diff, scaled, tot_u, tot_s = _score_fn(
+            ScalerParams(*self.error_scaler_), jnp.asarray(target), jnp.asarray(output)
+        )
+
+        frames = {
+            ("model-input", t): inp[:, i] for i, t in enumerate(tags)
+        }
+        frames.update({("model-output", t): np.asarray(output)[:, i] for i, t in enumerate(tags)})
+        frames.update({("tag-anomaly-unscaled", t): np.asarray(diff)[:, i] for i, t in enumerate(tags)})
+        frames.update({("tag-anomaly-scaled", t): np.asarray(scaled)[:, i] for i, t in enumerate(tags)})
+        df = pd.DataFrame(frames, index=index)
+        df[("total-anomaly-unscaled", "")] = np.asarray(tot_u)
+        df[("total-anomaly-scaled", "")] = np.asarray(tot_s)
+        df.columns = pd.MultiIndex.from_tuples(df.columns)
+        return df
+
+    def get_metadata(self) -> Dict[str, Any]:
+        md: Dict[str, Any] = {
+            "type": type(self).__name__,
+            "base_estimator": (
+                self.base_estimator.get_metadata()
+                if hasattr(self.base_estimator, "get_metadata")
+                else repr(self.base_estimator)
+            ),
+        }
+        if self.feature_thresholds_ is not None:
+            md["feature-thresholds"] = {
+                t: float(v) for t, v in zip(self.tags_ or [], self.feature_thresholds_)
+            }
+            md["total-anomaly-threshold"] = self.total_threshold_
+        return md

@@ -1,0 +1,47 @@
+"""Abstract estimator contract.
+
+Reference parity: ``GordoBase`` in gordo_components/model/base.py
+(unverified; SURVEY.md §2 "model.base") — the minimal surface every model
+must expose so the builder, serializer, server, and watchman can treat all
+models uniformly: ``get_metadata()``, ``score()``, ``get_params()``.
+"""
+
+import abc
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class GordoBase(abc.ABC):
+    """Base contract for all models in the framework."""
+
+    @abc.abstractmethod
+    def fit(self, X: np.ndarray, y: Optional[np.ndarray] = None, **kwargs):
+        """Fit the model to X (y defaults per estimator semantics)."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> Dict[str, Any]:
+        """JSON-serializable metadata describing configuration and training
+        history; threaded into the build artifact and served at
+        ``GET /metadata``."""
+
+    @abc.abstractmethod
+    def score(self, X: np.ndarray, y: Optional[np.ndarray] = None) -> float:
+        """Explained-variance score of the model on (X, y)."""
+
+    def get_params(self, deep=True) -> Dict[str, Any]:
+        """Constructor params captured by ``capture_args`` (sklearn-style)."""
+        return dict(getattr(self, "_params", {}))
+
+    def set_params(self, **params):
+        self._params = {**getattr(self, "_params", {}), **params}
+        for k, v in params.items():
+            setattr(self, k, v)
+        return self
+
+    def __sklearn_tags__(self):
+        # sklearn >= 1.6 Pipelines require step tags; delegate to sklearn's
+        # default implementation without inheriting its get_params machinery
+        from sklearn.base import BaseEstimator as _SkBase
+
+        return _SkBase.__sklearn_tags__(self)

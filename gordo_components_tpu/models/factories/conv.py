@@ -1,0 +1,58 @@
+"""Conv1D autoencoder factory — extended model zoo (BASELINE.json config 4;
+not present upstream, SURVEY.md §7 stage 7).
+
+Operates on lookback windows (batch, lookback, n_features): a strided
+Conv1D encoder halves the time axis per layer, a ConvTranspose decoder
+mirrors it, and the estimator takes the *last* reconstructed step as the
+model output so Conv models drop into the same window-batch training loop
+as the LSTMs. Convolutions lower to MXU matmuls on TPU.
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from gordo_components_tpu.models.factories.feedforward import resolve_activation
+from gordo_components_tpu.models.register import register_model_builder
+
+
+class Conv1DAutoEncoder(nn.Module):
+    n_features: int
+    channels: Tuple[int, ...]
+    kernel_size: int
+    func: str
+    compute_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (batch, lookback, n_features); lookback must be divisible by
+        # 2**len(channels) (the estimator pads windows to this).
+        dtype = jnp.dtype(self.compute_dtype)
+        x = x.astype(dtype)
+        act = resolve_activation(self.func)
+        for ch in self.channels:
+            x = act(nn.Conv(ch, (self.kernel_size,), strides=(2,), dtype=dtype)(x))
+        for ch in reversed(self.channels):
+            x = act(nn.ConvTranspose(ch, (self.kernel_size,), strides=(2,), dtype=dtype)(x))
+        x = nn.Conv(self.n_features, (self.kernel_size,), dtype=dtype)(x)
+        return x[:, -1, :].astype(jnp.float32)
+
+
+@register_model_builder(type="ConvAutoEncoder")
+@register_model_builder(type="LSTMAutoEncoder")
+def conv1d_autoencoder(
+    n_features: int,
+    channels: Sequence[int] = (32, 16),
+    kernel_size: int = 3,
+    func: str = "relu",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> Conv1DAutoEncoder:
+    return Conv1DAutoEncoder(
+        n_features=n_features,
+        channels=tuple(channels),
+        kernel_size=kernel_size,
+        func=func,
+        compute_dtype=compute_dtype,
+    )

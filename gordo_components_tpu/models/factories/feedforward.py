@@ -1,0 +1,143 @@
+"""Feedforward autoencoder factories.
+
+Reference parity: gordo_components/model/factories/feedforward_autoencoder.py
+(unverified; SURVEY.md §2) — dense encoder/decoder stacks where
+``feedforward_hourglass`` shrinks encoder dims by ``compression_factor``
+over ``encoding_layers``. TPU notes: all layers are plain matmuls (MXU
+work); the module computes in a configurable dtype (bfloat16 by default for
+the fleet path) while params stay float32.
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from gordo_components_tpu.models.register import register_model_builder
+
+_ACTIVATIONS = {
+    "tanh": nn.tanh,
+    "relu": nn.relu,
+    "sigmoid": nn.sigmoid,
+    "elu": nn.elu,
+    "linear": lambda x: x,
+    "softplus": nn.softplus,
+}
+
+
+def resolve_activation(name: str):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"Unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}")
+
+
+class FeedForwardAutoEncoder(nn.Module):
+    """Dense autoencoder: encoder dims, then decoder dims, then a linear
+    output layer back to ``n_features``."""
+
+    n_features: int
+    encoding_dim: Tuple[int, ...]
+    decoding_dim: Tuple[int, ...]
+    encoding_func: Tuple[str, ...]
+    decoding_func: Tuple[str, ...]
+    out_func: str = "linear"
+    compute_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.compute_dtype)
+        x = x.astype(dtype)
+        for dim, func in zip(self.encoding_dim, self.encoding_func):
+            x = resolve_activation(func)(nn.Dense(dim, dtype=dtype)(x))
+        for dim, func in zip(self.decoding_dim, self.decoding_func):
+            x = resolve_activation(func)(nn.Dense(dim, dtype=dtype)(x))
+        x = resolve_activation(self.out_func)(nn.Dense(self.n_features, dtype=dtype)(x))
+        return x.astype(jnp.float32)
+
+
+def _norm_funcs(funcs, n, default):
+    if funcs is None:
+        return (default,) * n
+    funcs = tuple(funcs)
+    if len(funcs) != n:
+        raise ValueError(f"Need {n} activation funcs, got {len(funcs)}")
+    return funcs
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_model(
+    n_features: int,
+    encoding_dim: Sequence[int] = (256, 128, 64),
+    decoding_dim: Sequence[int] = (64, 128, 256),
+    encoding_func: Sequence[str] = None,
+    decoding_func: Sequence[str] = None,
+    out_func: str = "linear",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> FeedForwardAutoEncoder:
+    """Fully specified dense autoencoder (reference: ``feedforward_model``)."""
+    return FeedForwardAutoEncoder(
+        n_features=n_features,
+        encoding_dim=tuple(encoding_dim),
+        decoding_dim=tuple(decoding_dim),
+        encoding_func=_norm_funcs(encoding_func, len(encoding_dim), "tanh"),
+        decoding_func=_norm_funcs(decoding_func, len(decoding_dim), "tanh"),
+        out_func=out_func,
+        compute_dtype=compute_dtype,
+    )
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_symmetric(
+    n_features: int,
+    dims: Sequence[int] = (256, 128, 64),
+    funcs: Sequence[str] = None,
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> FeedForwardAutoEncoder:
+    """Symmetric dense autoencoder: decoder mirrors the encoder
+    (reference: ``feedforward_symmetric``)."""
+    if not dims:
+        raise ValueError("dims must be non-empty")
+    funcs = _norm_funcs(funcs, len(dims), "tanh")
+    return feedforward_model(
+        n_features,
+        encoding_dim=tuple(dims),
+        decoding_dim=tuple(reversed(dims)),
+        encoding_func=funcs,
+        decoding_func=tuple(reversed(funcs)),
+        compute_dtype=compute_dtype,
+    )
+
+
+def hourglass_calc_dims(compression_factor: float, encoding_layers: int, n_features: int):
+    """Linearly interpolated layer dims from ``n_features`` down to
+    ``n_features * compression_factor`` (reference hourglass geometry)."""
+    if not 0 <= compression_factor <= 1:
+        raise ValueError("compression_factor must be 0..1")
+    if encoding_layers < 1:
+        raise ValueError("encoding_layers must be >= 1")
+    smallest = max(1, round(n_features * compression_factor))
+    dims = [
+        max(1, round(n_features - (n_features - smallest) * (i / encoding_layers)))
+        for i in range(1, encoding_layers + 1)
+    ]
+    return tuple(dims)
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_hourglass(
+    n_features: int,
+    encoding_layers: int = 3,
+    compression_factor: float = 0.5,
+    func: str = "tanh",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> FeedForwardAutoEncoder:
+    """Hourglass dense autoencoder — the reference's default model
+    (reference: ``feedforward_hourglass``)."""
+    dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
+    return feedforward_symmetric(
+        n_features, dims=dims, funcs=(func,) * len(dims), compute_dtype=compute_dtype
+    )

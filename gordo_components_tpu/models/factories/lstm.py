@@ -1,0 +1,122 @@
+"""LSTM autoencoder/forecast factories.
+
+Reference parity: gordo_components/model/factories/lstm_autoencoder.py
+(unverified; SURVEY.md §2) — stacked LSTM encoders over a
+``lookback_window`` of timesteps, emitting one n_features vector (the
+reconstruction of the current step for the autoencoder, or t+1 for the
+forecaster; which target is the *estimator's* choice, not the factory's).
+
+TPU-native design: recurrence is ``flax.linen.RNN`` (``lax.scan`` under the
+hood — compiler-friendly sequential control flow, static window length);
+windows are a batch dimension (ops/windows.py), so the per-step matmuls
+batch onto the MXU across windows.
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from gordo_components_tpu.models.factories.feedforward import resolve_activation
+from gordo_components_tpu.models.factories.feedforward import hourglass_calc_dims
+from gordo_components_tpu.models.register import register_model_builder
+
+
+class LSTMStack(nn.Module):
+    """Stacked LSTMs over (batch, lookback, n_features) -> (batch, n_features).
+
+    Each layer's full output sequence feeds the next; the last layer's final
+    hidden state passes through a Dense head back to feature space.
+    """
+
+    n_features: int
+    dims: Tuple[int, ...]
+    funcs: Tuple[str, ...]
+    out_func: str = "linear"
+    compute_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.compute_dtype)
+        x = x.astype(dtype)
+        for dim, func in zip(self.dims, self.funcs):
+            cell = nn.OptimizedLSTMCell(features=dim, dtype=dtype)
+            x = nn.RNN(cell)(x)
+            x = resolve_activation(func)(x)
+        x = x[:, -1, :]  # final hidden state of last layer
+        x = resolve_activation(self.out_func)(nn.Dense(self.n_features, dtype=dtype)(x))
+        return x.astype(jnp.float32)
+
+
+def _norm_funcs(funcs, n, default="tanh"):
+    if funcs is None:
+        return (default,) * n
+    funcs = tuple(funcs)
+    if len(funcs) != n:
+        raise ValueError(f"Need {n} activation funcs, got {len(funcs)}")
+    return funcs
+
+
+@register_model_builder(type="LSTMAutoEncoder")
+@register_model_builder(type="LSTMForecast")
+def lstm_model(
+    n_features: int,
+    dims: Sequence[int] = (64, 64),
+    funcs: Sequence[str] = None,
+    out_func: str = "linear",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> LSTMStack:
+    """Fully specified LSTM stack (reference: ``lstm_model``)."""
+    dims = tuple(dims)
+    return LSTMStack(
+        n_features=n_features,
+        dims=dims,
+        funcs=_norm_funcs(funcs, len(dims)),
+        out_func=out_func,
+        compute_dtype=compute_dtype,
+    )
+
+
+@register_model_builder(type="LSTMAutoEncoder")
+@register_model_builder(type="LSTMForecast")
+def lstm_symmetric(
+    n_features: int,
+    dims: Sequence[int] = (64, 32),
+    funcs: Sequence[str] = None,
+    out_func: str = "linear",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> LSTMStack:
+    """Symmetric LSTM autoencoder: encoder dims then mirrored decoder dims
+    (reference: ``lstm_symmetric``)."""
+    dims = tuple(dims)
+    if not dims:
+        raise ValueError("dims must be non-empty")
+    funcs = _norm_funcs(funcs, len(dims))
+    full_dims = dims + tuple(reversed(dims))
+    full_funcs = funcs + tuple(reversed(funcs))
+    return lstm_model(
+        n_features, dims=full_dims, funcs=full_funcs, out_func=out_func,
+        compute_dtype=compute_dtype,
+    )
+
+
+@register_model_builder(type="LSTMAutoEncoder")
+@register_model_builder(type="LSTMForecast")
+def lstm_hourglass(
+    n_features: int,
+    encoding_layers: int = 3,
+    compression_factor: float = 0.5,
+    func: str = "tanh",
+    out_func: str = "linear",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> LSTMStack:
+    """Hourglass LSTM (reference: ``lstm_hourglass``): layer sizes shrink by
+    ``compression_factor`` then mirror back up."""
+    dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
+    return lstm_symmetric(
+        n_features, dims=dims, funcs=(func,) * len(dims), out_func=out_func,
+        compute_dtype=compute_dtype,
+    )

@@ -1,0 +1,79 @@
+"""Variational feedforward autoencoder — extended model zoo (BASELINE.json
+config 4; not present upstream, SURVEY.md §7 stage 7).
+
+Standard VAE over flat feature vectors: dense encoder to (mu, logvar),
+reparameterized sample, dense decoder. The module's ``__call__`` returns the
+mean-decoded reconstruction (deterministic, for scoring); training uses
+``elbo_terms`` via the estimator's loss hook, which adds the KL term. The
+sampling rng is a Flax ``'sample'`` rng collection so the fleet engine can
+vmap per-model rngs.
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gordo_components_tpu.models.factories.feedforward import resolve_activation
+from gordo_components_tpu.models.register import register_model_builder
+
+
+class VariationalAutoEncoder(nn.Module):
+    n_features: int
+    dims: Tuple[int, ...]
+    latent_dim: int
+    func: str
+    compute_dtype: str = "float32"
+
+    def _encode(self, x):
+        dtype = jnp.dtype(self.compute_dtype)
+        act = resolve_activation(self.func)
+        h = x.astype(dtype)
+        for i, dim in enumerate(self.dims):
+            h = act(nn.Dense(dim, dtype=dtype, name=f"enc_{i}")(h))
+        mu = nn.Dense(self.latent_dim, dtype=dtype, name="mu")(h)
+        logvar = nn.Dense(self.latent_dim, dtype=dtype, name="logvar")(h)
+        return mu, logvar
+
+    def _decode(self, z):
+        dtype = jnp.dtype(self.compute_dtype)
+        act = resolve_activation(self.func)
+        h = z
+        for i, dim in enumerate(reversed(self.dims)):
+            h = act(nn.Dense(dim, dtype=dtype, name=f"dec_{i}")(h))
+        return nn.Dense(self.n_features, dtype=dtype, name="out")(h).astype(jnp.float32)
+
+    @nn.compact
+    def __call__(self, x):
+        mu, logvar = self._encode(x)
+        return self._decode(mu)  # deterministic reconstruction for scoring
+
+    @nn.compact
+    def elbo_terms(self, x):
+        """Returns (reconstruction, kl_per_sample) using a sampled latent."""
+        mu, logvar = self._encode(x)
+        rng = self.make_rng("sample")
+        noise = jax.random.normal(rng, mu.shape, dtype=mu.dtype)
+        z = mu + jnp.exp(0.5 * logvar) * noise
+        recon = self._decode(z)
+        kl = -0.5 * jnp.sum(1 + logvar - mu**2 - jnp.exp(logvar), axis=-1)
+        return recon, kl.astype(jnp.float32)
+
+
+@register_model_builder(type="AutoEncoder")
+def feedforward_variational(
+    n_features: int,
+    dims: Sequence[int] = (128, 64),
+    latent_dim: int = 16,
+    func: str = "tanh",
+    compute_dtype: str = "float32",
+    **_ignored,
+) -> VariationalAutoEncoder:
+    return VariationalAutoEncoder(
+        n_features=n_features,
+        dims=tuple(dims),
+        latent_dim=latent_dim,
+        func=func,
+        compute_dtype=compute_dtype,
+    )

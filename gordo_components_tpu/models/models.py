@@ -1,0 +1,345 @@
+"""sklearn-compatible JAX/Flax autoencoder estimators.
+
+Reference parity: gordo_components/model/models.py (unverified; SURVEY.md §2
+"model.models") — ``KerasBaseEstimator`` / ``KerasAutoEncoder`` /
+``KerasLSTMAutoEncoder`` / ``KerasLSTMForecast``. Same estimator semantics
+(fit reconstructs X; LSTM variants window the series with
+``lookback_window`` and reconstruct the current step or forecast t+1; score
+is explained variance; per-epoch history recorded into metadata), but the
+engine is the functional train core (train_core.py): one jit'd epoch
+program, on-device shuffling, static shapes, bfloat16-capable.
+
+These classes drop into ``sklearn.pipeline.Pipeline`` and pickle cleanly
+(params are converted to numpy pytrees), which is what the serializer and
+server rely on.
+"""
+
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_components_tpu.models.base import GordoBase
+from gordo_components_tpu.models.register import lookup_factory
+from gordo_components_tpu.models import factories  # noqa: F401 — registers factories
+from gordo_components_tpu.models import train_core
+from gordo_components_tpu.ops.losses import explained_variance
+from gordo_components_tpu.ops.windows import sliding_windows
+from gordo_components_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+def _as_float32(X) -> np.ndarray:
+    """DataFrame/array -> float32 ndarray (reference accepts both)."""
+    if hasattr(X, "values"):
+        X = X.values
+    return np.asarray(X, dtype=np.float32)
+
+
+class BaseEstimator(GordoBase):
+    """Shared engine for all autoencoder estimators.
+
+    ``kind`` selects a registered factory for this estimator's type (class
+    name), exactly like the reference's ``KerasBaseEstimator``; remaining
+    ``**kwargs`` flow to the factory.
+    """
+
+    # registry type; subclasses override (class name by default)
+    @property
+    def _registry_type(self) -> str:
+        return type(self).__name__
+
+    @capture_args
+    def __init__(
+        self,
+        kind: str = "feedforward_hourglass",
+        batch_size: int = 100,
+        epochs: int = 10,
+        learning_rate: float = 1e-3,
+        optimizer: str = "adam",
+        loss: str = "auto",
+        kl_weight: float = 1.0,
+        validation_split: float = 0.0,
+        early_stopping_patience: Optional[int] = None,
+        early_stopping_min_delta: float = 0.0,
+        seed: int = 0,
+        compute_dtype: str = "float32",
+        **factory_kwargs,
+    ):
+        self.kind = kind
+        self.batch_size = int(batch_size)
+        self.epochs = int(epochs)
+        self.learning_rate = float(learning_rate)
+        self.optimizer = optimizer
+        self.loss = loss
+        self.kl_weight = float(kl_weight)
+        self.validation_split = float(validation_split)
+        self.early_stopping_patience = early_stopping_patience
+        self.early_stopping_min_delta = float(early_stopping_min_delta)
+        self.seed = int(seed)
+        self.compute_dtype = compute_dtype
+        self.factory_kwargs = factory_kwargs
+        # fitted state
+        self.params_ = None
+        self.n_features_ = None
+        self.history: Dict[str, list] = {}
+        self._module = None
+        # validate the kind eagerly for fail-fast config errors
+        lookup_factory(self._registry_type, kind)
+
+    # ------------------------------------------------------------------ #
+    # module/data plumbing — subclasses specialize windowing semantics
+    # ------------------------------------------------------------------ #
+
+    def _build_module(self, n_features: int):
+        factory = lookup_factory(self._registry_type, self.kind)
+        return factory(
+            n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
+        )
+
+    def _make_xy(self, X: np.ndarray, y: Optional[np.ndarray]):
+        """(train_inputs, train_targets) — AE default: reconstruct X."""
+        return X, X if y is None else _as_float32(y)
+
+    def _resolved_loss(self) -> str:
+        if self.loss != "auto":
+            return self.loss
+        # variational modules train with the ELBO; everything else MSE
+        return "vae" if hasattr(self._module, "elbo_terms") else "mse"
+
+    @property
+    def module(self):
+        if self._module is None:
+            if self.n_features_ is None:
+                raise RuntimeError("Model is not fitted; no module to build")
+            self._module = self._build_module(self.n_features_)
+        return self._module
+
+    # ------------------------------------------------------------------ #
+    # sklearn-style API
+    # ------------------------------------------------------------------ #
+
+    def fit(self, X, y=None, **kwargs):
+        X = _as_float32(X)
+        if X.ndim == 1:
+            X = X[:, None]
+        Xin, Yin = self._make_xy(X, y)
+        self.n_features_ = int(X.shape[-1])
+        self._module = None  # rebuild for (possibly) new n_features
+        module = self.module
+
+        n = Xin.shape[0]
+        if n == 0:
+            raise ValueError("Cannot fit on empty data")
+        bs = min(self.batch_size, n)
+
+        # host-side split, device-side everything else
+        n_val = int(n * self.validation_split)
+        if n_val > 0:
+            Xtr, Ytr = Xin[:-n_val], Yin[:-n_val]
+            Xva, Yva = Xin[-n_val:], Yin[-n_val:]
+        else:
+            Xtr, Ytr, Xva, Yva = Xin, Yin, None, None
+
+        opt = train_core.make_optimizer(self.optimizer, self.learning_rate)
+        loss = self._resolved_loss()
+        init_fn, epoch_fn = train_core.make_train_fns(
+            module, opt, bs, loss=loss, kl_weight=self.kl_weight
+        )
+        epoch_fn = jax.jit(epoch_fn, donate_argnums=(0,))
+
+        Xp, Yp, mask, _ = train_core.pad_to_batches(Xtr, Ytr, bs)
+        Xp, Yp, mask = jnp.asarray(Xp), jnp.asarray(Yp), jnp.asarray(mask)
+        state = init_fn(jax.random.PRNGKey(self.seed), Xp[0])
+
+        eval_fn = None
+        if Xva is not None:
+            eval_fn = jax.jit(
+                train_core.make_eval_fn(module, bs, loss=loss, kl_weight=self.kl_weight)
+            )
+            Xvp, Yvp, vmask, _ = train_core.pad_to_batches(Xva, Yva, bs)
+            Xvp, Yvp, vmask = jnp.asarray(Xvp), jnp.asarray(Yvp), jnp.asarray(vmask)
+
+        self.history = {"loss": []}
+        if eval_fn is not None:
+            self.history["val_loss"] = []
+        best, patience_left = np.inf, self.early_stopping_patience
+        best_params = None
+        for epoch in range(self.epochs):
+            state, loss_val = epoch_fn(state, Xp, Yp, mask)
+            loss_f = float(loss_val)
+            self.history["loss"].append(loss_f)
+            monitored = loss_f
+            if eval_fn is not None:
+                val = float(eval_fn(state, Xvp, Yvp, vmask))
+                self.history["val_loss"].append(val)
+                monitored = val
+            if self.early_stopping_patience is not None:
+                if monitored < best - self.early_stopping_min_delta:
+                    best, patience_left = monitored, self.early_stopping_patience
+                    best_params = jax.tree.map(np.asarray, state.params)
+                else:
+                    patience_left -= 1
+                    if patience_left <= 0:
+                        logger.info("Early stopping at epoch %d", epoch + 1)
+                        break
+
+        final = best_params if best_params is not None else state.params
+        self.params_ = jax.tree.map(np.asarray, final)
+        return self
+
+    def _check_fitted(self):
+        if self.params_ is None:
+            raise RuntimeError(f"{type(self).__name__} has not been fitted")
+
+    def predict(self, X) -> np.ndarray:
+        """Reconstruction of X (reference: ``KerasAutoEncoder.transform``
+        returns the autoencoder output)."""
+        self._check_fitted()
+        X = _as_float32(X)
+        if X.ndim == 1:
+            X = X[:, None]
+        return train_core.batched_apply(self.module, self.params_, X)
+
+    # sklearn Pipeline compatibility: AE estimators act as transformers too
+    def transform(self, X) -> np.ndarray:
+        return self.predict(X)
+
+    def score(self, X, y=None) -> float:
+        """Explained variance of the reconstruction (reference semantics)."""
+        self._check_fitted()
+        X = _as_float32(X)
+        target = X if y is None else _as_float32(y)
+        pred = self.predict(X)
+        return float(explained_variance(jnp.asarray(target), jnp.asarray(pred)))
+
+    def get_metadata(self) -> Dict[str, Any]:
+        md: Dict[str, Any] = {
+            "type": type(self).__name__,
+            "kind": self.kind,
+            "params": _jsonable(self.get_params()),
+        }
+        if self.params_ is not None:
+            md["n_features"] = self.n_features_
+            md["history"] = self.history
+            md["parameter_count"] = int(
+                sum(int(np.size(p)) for p in jax.tree.leaves(self.params_))
+            )
+        return md
+
+    # ------------------------------------------------------------------ #
+    # pickling (serializer dump/load; reference made Keras picklable via
+    # HDF5 bytes — here params are already a numpy pytree, so default
+    # pickling works once the unpicklable Flax module is dropped)
+    # ------------------------------------------------------------------ #
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_module"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class AutoEncoder(BaseEstimator):
+    """Feedforward autoencoder over flat feature vectors
+    (reference: ``KerasAutoEncoder``)."""
+
+
+class SequenceBaseEstimator(BaseEstimator):
+    """Shared windowing logic for sequence estimators: X is windowed into
+    (n_windows, lookback_window, n_features) on device."""
+
+    @capture_args
+    def __init__(self, kind: str = "lstm_hourglass", lookback_window: int = 10, **kwargs):
+        self.lookback_window = int(lookback_window)
+        super().__init__(kind=kind, **kwargs)
+        # capture_args on both ctors: merge so lookback_window is retained
+        self._params = {"kind": kind, "lookback_window": lookback_window, **kwargs}
+
+    # offset: prediction i corresponds to input row i + offset
+    _target_offset = 0  # 0 => reconstruct window's last step
+
+    def _window_inputs(self, X: np.ndarray) -> np.ndarray:
+        lb = self.lookback_window
+        if X.shape[0] < lb + self._target_offset:
+            raise ValueError(
+                f"Need at least lookback_window+{self._target_offset}="
+                f"{lb + self._target_offset} rows, got {X.shape[0]}"
+            )
+        W = np.asarray(sliding_windows(jnp.asarray(X), lb))
+        if self._target_offset:
+            W = W[: -self._target_offset]
+        return W
+
+    def _make_xy(self, X: np.ndarray, y=None):
+        base = X if y is None else _as_float32(y)
+        W = self._window_inputs(X)
+        targets = base[self.lookback_window - 1 + self._target_offset :]
+        return W, targets
+
+    def predict(self, X) -> np.ndarray:
+        """Output row i is the model value for input row
+        ``i + lookback_window - 1 + offset`` (reference LSTM semantics:
+        output is shorter than input by the warm-up window)."""
+        self._check_fitted()
+        X = _as_float32(X)
+        if X.ndim == 1:
+            X = X[:, None]
+        W = self._window_inputs(X)
+        return train_core.batched_apply(self.module, self.params_, W)
+
+    def score(self, X, y=None) -> float:
+        self._check_fitted()
+        X = _as_float32(X)
+        base = X if y is None else _as_float32(y)
+        target = base[self.lookback_window - 1 + self._target_offset :]
+        pred = self.predict(X)
+        return float(explained_variance(jnp.asarray(target), jnp.asarray(pred)))
+
+
+class LSTMAutoEncoder(SequenceBaseEstimator):
+    """Windowed sequence autoencoder reconstructing the current step
+    (reference: ``KerasLSTMAutoEncoder``)."""
+
+    _target_offset = 0
+
+
+class LSTMForecast(SequenceBaseEstimator):
+    """Windowed sequence model forecasting t+1
+    (reference: ``KerasLSTMForecast``)."""
+
+    _target_offset = 1
+
+
+class ConvAutoEncoder(SequenceBaseEstimator):
+    """Conv1D window autoencoder (extended zoo, BASELINE.json config 4).
+    ``lookback_window`` must be divisible by ``2**len(channels)``."""
+
+    @capture_args
+    def __init__(self, kind: str = "conv1d_autoencoder", lookback_window: int = 16, **kwargs):
+        super().__init__(kind=kind, lookback_window=lookback_window, **kwargs)
+        self._params = {"kind": kind, "lookback_window": lookback_window, **kwargs}
+
+    _target_offset = 0
+
+
+def _jsonable(obj):
+    """Best-effort conversion of captured params to JSON-safe values."""
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)

@@ -1,0 +1,50 @@
+"""Model-architecture registry.
+
+Reference parity: ``register_model_builder`` in gordo_components/model/
+register.py (unverified; SURVEY.md §2 "model.register") — maps estimator
+class name -> {factory name -> callable}, enabling
+``AutoEncoder(kind="feedforward_hourglass")``.
+
+Factories here return **Flax modules** (pure apply functions) rather than
+compiled Keras objects, so the same factory output feeds both the
+single-model estimator and the vmap'd fleet engine.
+"""
+
+from typing import Callable, Dict
+
+# estimator-class-name -> factory-name -> factory callable
+FACTORY_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_model_builder(type: str) -> Callable:
+    """Class decorator-style registrar: ``@register_model_builder(type="AutoEncoder")``
+    on a factory function registers it under that estimator type by its
+    ``__name__``."""
+
+    def decorator(factory: Callable) -> Callable:
+        FACTORY_REGISTRY.setdefault(type, {})[factory.__name__] = factory
+        return factory
+
+    return decorator
+
+
+def lookup_factory(type: str, kind: str) -> Callable:
+    """Resolve a factory for an estimator type, with helpful errors."""
+    # Reference-era estimator names map onto our JAX estimators so old
+    # configs keep working (KerasAutoEncoder -> AutoEncoder, etc).
+    aliases = {
+        "KerasAutoEncoder": "AutoEncoder",
+        "KerasLSTMAutoEncoder": "LSTMAutoEncoder",
+        "KerasLSTMForecast": "LSTMForecast",
+    }
+    type = aliases.get(type, type)
+    try:
+        by_kind = FACTORY_REGISTRY[type]
+    except KeyError:
+        raise ValueError(
+            f"No factories registered for estimator type {type!r}; known: {sorted(FACTORY_REGISTRY)}"
+        )
+    try:
+        return by_kind[kind]
+    except KeyError:
+        raise ValueError(f"Unknown kind {kind!r} for {type!r}; known: {sorted(by_kind)}")

@@ -1,0 +1,216 @@
+"""Functional training core: pure init/epoch/predict functions over explicit
+state pytrees.
+
+This replaces the reference's ``keras.Model.fit`` inner loop
+(gordo_components/model/models.py, unverified; SURVEY.md §3.1 "the COMPUTE
+HOT LOOP") with a TPU-idiomatic design:
+
+- one jit'd **epoch** program: on-device shuffle (``jax.random.permutation``)
+  + ``lax.scan`` over fixed-size batches — a single XLA computation per
+  epoch, no per-batch host round-trips, static shapes throughout;
+- ragged data handled by **padding + masks**, never dynamic shapes;
+- everything is written to be ``vmap``-ed over a leading model axis: the
+  fleet engine (parallel/fleet.py) maps these exact functions over stacked
+  params to train thousands of models in one program.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gordo_components_tpu.ops.losses import mse_loss
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+def make_optimizer(name: str = "adam", learning_rate: float = 1e-3, **kwargs) -> optax.GradientTransformation:
+    """Resolve an optax optimizer by name (reference models compile with
+    Keras optimizer names; same strings work here)."""
+    name = name.lower()
+    table = {
+        "adam": optax.adam,
+        "adamw": optax.adamw,
+        "sgd": optax.sgd,
+        "rmsprop": optax.rmsprop,
+        "adagrad": optax.adagrad,
+    }
+    try:
+        return table[name](learning_rate, **kwargs)
+    except KeyError:
+        raise ValueError(f"Unknown optimizer {name!r}; known: {sorted(table)}")
+
+
+def pad_to_batches(
+    X: np.ndarray, Y: np.ndarray, batch_size: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Pad (X, Y) with zero rows to a multiple of ``batch_size``.
+
+    Returns (X_pad, Y_pad, mask, n_batches); mask is 1.0 for real rows.
+    Padding keeps every batch the same shape so the epoch program compiles
+    once regardless of dataset length.
+    """
+    n = X.shape[0]
+    if n == 0:
+        raise ValueError("Cannot train on an empty dataset")
+    n_batches = max(1, -(-n // batch_size))
+    n_pad = n_batches * batch_size
+    mask = np.zeros((n_pad,), dtype=np.float32)
+    mask[:n] = 1.0
+    X_pad = np.zeros((n_pad,) + X.shape[1:], dtype=np.float32)
+    X_pad[:n] = X
+    Y_pad = np.zeros((n_pad,) + Y.shape[1:], dtype=np.float32)
+    Y_pad[:n] = Y
+    return X_pad, Y_pad, mask, n_batches
+
+
+def make_loss_fn(module, loss: str = "mse", kl_weight: float = 1.0) -> Callable:
+    """Build ``loss_fn(params, rng, xb, yb, maskb) -> scalar``.
+
+    ``loss='mse'`` covers the reference's autoencoder losses; ``loss='vae'``
+    calls the module's ``elbo_terms`` (variational zoo) adding the KL term.
+    """
+    if loss == "mse":
+
+        def loss_fn(params, rng, xb, yb, maskb):
+            pred = module.apply(params, xb)
+            return mse_loss(pred, yb, maskb)
+
+    elif loss == "vae":
+
+        def loss_fn(params, rng, xb, yb, maskb):
+            recon, kl = module.apply(
+                params, xb, method="elbo_terms", rngs={"sample": rng}
+            )
+            rec = mse_loss(recon, yb, maskb)
+            klm = jnp.sum(kl * maskb) / jnp.maximum(jnp.sum(maskb), 1.0)
+            return rec + kl_weight * klm
+
+    else:
+        raise ValueError(f"Unknown loss {loss!r} (known: mse, vae)")
+    return loss_fn
+
+
+def make_train_fns(
+    module,
+    optimizer: optax.GradientTransformation,
+    batch_size: int,
+    loss: str = "mse",
+    kl_weight: float = 1.0,
+):
+    """Returns ``(init_fn, epoch_fn)``.
+
+    - ``init_fn(rng, sample_x) -> TrainState`` (sample_x: one batch-shaped
+      row, used only for shape inference)
+    - ``epoch_fn(state, X, Y, mask) -> (state, mean_loss)`` where X/Y/mask
+      are padded to ``n_batches * batch_size`` rows (see ``pad_to_batches``).
+      Performs an on-device shuffle then ``lax.scan`` over batches.
+
+    Both are pure and vmap-able over a leading model axis.
+    """
+    loss_fn = make_loss_fn(module, loss=loss, kl_weight=kl_weight)
+
+    def init_fn(rng: jax.Array, sample_x: jnp.ndarray) -> TrainState:
+        init_rng, state_rng = jax.random.split(rng)
+        params = module.init(init_rng, sample_x[None, ...])
+        opt_state = optimizer.init(params)
+        return TrainState(params=params, opt_state=opt_state, rng=state_rng)
+
+    def epoch_fn(state: TrainState, X, Y, mask):
+        n_pad = X.shape[0]
+        n_batches = n_pad // batch_size
+        keys = jax.random.split(state.rng, n_batches + 2)
+        rng, perm_rng, rngs = keys[0], keys[1], keys[2:]
+        perm = jax.random.permutation(perm_rng, n_pad)
+        Xs = X[perm].reshape((n_batches, batch_size) + X.shape[1:])
+        Ys = Y[perm].reshape((n_batches, batch_size) + Y.shape[1:])
+        Ms = mask[perm].reshape((n_batches, batch_size))
+
+        def step(carry, batch):
+            params, opt_state = carry
+            xb, yb, mb, brng = batch
+            loss_val, grads = jax.value_and_grad(loss_fn)(params, brng, xb, yb, mb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # weight the batch loss by its real-row count for a correct
+            # dataset-mean when the last batch is partly padding
+            return (params, opt_state), (loss_val, jnp.sum(mb))
+
+        (params, opt_state), (losses, counts) = jax.lax.scan(
+            step, (state.params, state.opt_state), (Xs, Ys, Ms, rngs)
+        )
+        mean_loss = jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
+        return TrainState(params=params, opt_state=opt_state, rng=rng), mean_loss
+
+    return init_fn, epoch_fn
+
+
+def make_eval_fn(module, batch_size: int, loss: str = "mse", kl_weight: float = 1.0):
+    """``eval_fn(state, X, Y, mask) -> mean_loss`` over padded data, no
+    parameter update (validation loss / early stopping)."""
+    loss_fn = make_loss_fn(module, loss=loss, kl_weight=kl_weight)
+
+    def eval_fn(state: TrainState, X, Y, mask):
+        n_batches = X.shape[0] // batch_size
+        Xs = X.reshape((n_batches, batch_size) + X.shape[1:])
+        Ys = Y.reshape((n_batches, batch_size) + Y.shape[1:])
+        Ms = mask.reshape((n_batches, batch_size))
+        rng = jax.random.PRNGKey(0)
+
+        def step(_, batch):
+            xb, yb, mb = batch
+            return None, (loss_fn(state.params, rng, xb, yb, mb), jnp.sum(mb))
+
+        _, (losses, counts) = jax.lax.scan(step, None, (Xs, Ys, Ms))
+        return jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
+
+    return eval_fn
+
+
+def batched_apply(
+    module, params, X: np.ndarray, batch_size: int = 4096
+) -> np.ndarray:
+    """Run ``module.apply`` over X in fixed-size chunks.
+
+    Pads to a multiple of ``batch_size`` and scans, so inference compiles
+    once per (batch_size, feature-shape) regardless of request length —
+    essential for the server, where request sizes vary per call.
+    """
+    n = X.shape[0]
+    if n == 0:
+        raise ValueError("empty input")
+    eff_bs = min(batch_size, _next_pow2(n))
+    n_batches = -(-n // eff_bs)
+    n_pad = n_batches * eff_bs
+    X_pad = np.zeros((n_pad,) + X.shape[1:], dtype=np.float32)
+    X_pad[:n] = X
+    out = _scan_apply(module, params, jnp.asarray(X_pad), eff_bs)
+    return np.asarray(out)[:n]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _scan_apply(module, params, X_pad, batch_size):
+    @jax.jit
+    def run(params, X_pad):
+        n_batches = X_pad.shape[0] // batch_size
+        Xs = X_pad.reshape((n_batches, batch_size) + X_pad.shape[1:])
+
+        def step(_, xb):
+            return None, module.apply(params, xb)
+
+        _, out = jax.lax.scan(step, None, Xs)
+        return out.reshape((n_batches * batch_size,) + out.shape[2:])
+
+    return run(params, X_pad)

@@ -1,0 +1,94 @@
+"""sklearn-compatible transformers backed by the pure-JAX scaler ops.
+
+The reference's default pipeline uses ``sklearn.preprocessing.MinMaxScaler``
+(SURVEY.md §2 "workflow"); that still works here. These equivalents exist so
+that (a) fleet-trained stacked scalers (parallel/fleet.py) unstack into
+pipeline steps, and (b) the whole scoring path can stay on-device.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_components_tpu.ops.scaler import (
+    ScalerParams,
+    fit_minmax,
+    fit_standard,
+    scaler_inverse_transform,
+    scaler_transform,
+)
+from gordo_components_tpu.utils import capture_args
+
+
+class _JaxScalerBase:
+    _fit_fn = None
+
+    def __init__(self):
+        self.scaler_params_: Optional[ScalerParams] = None
+        self.n_features_: Optional[int] = None
+
+    def set_fitted(self, params: ScalerParams, n_features: int):
+        """Adopt externally fitted (e.g. fleet-stacked) scaler params."""
+        self.scaler_params_ = ScalerParams(
+            shift=np.asarray(params.shift), scale=np.asarray(params.scale)
+        )
+        self.n_features_ = n_features
+        return self
+
+    def fit(self, X, y=None):
+        X = np.asarray(X.values if hasattr(X, "values") else X, dtype=np.float32)
+        params = self._fit_params(jnp.asarray(X))
+        return self.set_fitted(params, X.shape[-1])
+
+    def _check(self):
+        if self.scaler_params_ is None:
+            raise RuntimeError(f"{type(self).__name__} is not fitted")
+
+    def transform(self, X):
+        self._check()
+        Xv = np.asarray(X.values if hasattr(X, "values") else X, dtype=np.float32)
+        return np.asarray(
+            scaler_transform(ScalerParams(*self.scaler_params_), jnp.asarray(Xv))
+        )
+
+    def inverse_transform(self, X):
+        self._check()
+        Xv = np.asarray(X.values if hasattr(X, "values") else X, dtype=np.float32)
+        return np.asarray(
+            scaler_inverse_transform(ScalerParams(*self.scaler_params_), jnp.asarray(Xv))
+        )
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X, y).transform(X)
+
+    def get_params(self, deep=True):
+        return dict(getattr(self, "_params", {}))
+
+    def set_params(self, **params):
+        self._params = {**getattr(self, "_params", {}), **params}
+        return self
+
+    def __sklearn_tags__(self):
+        from sklearn.base import BaseEstimator as _SkBase
+
+        return _SkBase.__sklearn_tags__(self)
+
+
+class JaxMinMaxScaler(_JaxScalerBase):
+    @capture_args
+    def __init__(self, feature_range=(0.0, 1.0)):
+        super().__init__()
+        self.feature_range = tuple(feature_range)
+
+    def _fit_params(self, X):
+        return fit_minmax(X, feature_range=self.feature_range)
+
+
+class JaxStandardScaler(_JaxScalerBase):
+    @capture_args
+    def __init__(self):
+        super().__init__()
+
+    def _fit_params(self, X):
+        return fit_standard(X)

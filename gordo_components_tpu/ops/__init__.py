@@ -1,0 +1,33 @@
+"""Pure-JAX functional primitives shared by the single-model estimators and
+the many-model fleet engine.
+
+Everything in this package is a pure function over explicit parameter
+pytrees — no hidden state — so every op is `jit`-able, `vmap`-able over a
+leading model axis (the fleet engine's core trick), and shardable with
+`shard_map`. This replaces the reference's reliance on sklearn/Keras
+stateful objects for the on-device compute path.
+"""
+
+from gordo_components_tpu.ops.scaler import (
+    ScalerParams,
+    fit_minmax,
+    fit_standard,
+    identity_scaler,
+    scaler_inverse_transform,
+    scaler_transform,
+)
+from gordo_components_tpu.ops.windows import sliding_windows, num_windows
+from gordo_components_tpu.ops.losses import mse_loss, explained_variance
+
+__all__ = [
+    "ScalerParams",
+    "fit_minmax",
+    "fit_standard",
+    "identity_scaler",
+    "scaler_transform",
+    "scaler_inverse_transform",
+    "sliding_windows",
+    "num_windows",
+    "mse_loss",
+    "explained_variance",
+]

@@ -1,0 +1,33 @@
+"""Losses and scores for autoencoder training and evaluation.
+
+Reference parity: the reference compiles Keras models with MSE-family losses
+and scores estimators with ``sklearn.metrics.explained_variance_score``
+(gordo_components/model/models.py, unverified; SURVEY.md §2). Implemented
+here as pure jnp functions with an optional sample mask so padded rows
+(fleet bucketing pads ragged per-machine datasets) drop out of the loss
+without dynamic shapes.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def mse_loss(pred: jnp.ndarray, target: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean squared error; ``mask`` is (n_samples,) with 1=real, 0=padding."""
+    err = (pred - target) ** 2
+    if mask is None:
+        return jnp.mean(err)
+    mask_b = mask.reshape((-1,) + (1,) * (err.ndim - 1))
+    denom = jnp.maximum(jnp.sum(mask), 1.0) * (err.size / err.shape[0])
+    return jnp.sum(err * mask_b) / denom
+
+
+def explained_variance(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
+    """Uniform-average explained variance, matching
+    ``sklearn.metrics.explained_variance_score`` defaults."""
+    diff = y_true - y_pred
+    num = jnp.var(diff - jnp.mean(diff, axis=0), axis=0)
+    den = jnp.var(y_true - jnp.mean(y_true, axis=0), axis=0)
+    ev = jnp.where(den > 0, 1.0 - num / jnp.where(den > 0, den, 1.0), 0.0)
+    return jnp.mean(ev)

@@ -1,0 +1,65 @@
+"""Feature scaling as pure functions over an explicit parameter struct.
+
+Reference parity: the reference's default pipeline is
+``sklearn.preprocessing.MinMaxScaler -> KerasAutoEncoder(kind=
+"feedforward_hourglass")`` (SURVEY.md §2 "workflow", unverified). Here the
+scaler is a pytree ``ScalerParams`` plus pure ``fit_*`` / ``scaler_transform``
+functions so that scaling fuses into the jit'd train/score programs (one XLA
+program end-to-end, no host round-trip) and vmaps over a model axis for the
+fleet engine — 10k per-model scalers are just a stacked ScalerParams pytree.
+
+All fits are NaN-tolerant (nan-min/max/mean) so upstream gap-filling can
+leave NaNs for masked rows without poisoning scaler statistics.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class ScalerParams(NamedTuple):
+    """Affine feature scaler: ``transform(x) = (x - shift) * scale``.
+
+    Covers min-max ((x-min)/(max-min)), standard ((x-mean)/std), and
+    identity as special cases, so a single struct serves every pipeline and
+    stays homogeneous under ``vmap`` stacking.
+    """
+
+    shift: jnp.ndarray  # (n_features,)
+    scale: jnp.ndarray  # (n_features,)
+
+
+def fit_minmax(X: jnp.ndarray, feature_range=(0.0, 1.0), eps: float = 1e-12) -> ScalerParams:
+    """Min-max scaler fit. X: (n_samples, n_features).
+
+    Matches sklearn.MinMaxScaler semantics for the default (0,1) range;
+    constant features map to the range minimum (scale guarded by ``eps``).
+    """
+    lo, hi = feature_range
+    xmin = jnp.nanmin(X, axis=0)
+    xmax = jnp.nanmax(X, axis=0)
+    span = jnp.where(jnp.abs(xmax - xmin) < eps, 1.0, xmax - xmin)
+    scale = (hi - lo) / span
+    # transform = (x - xmin) * scale + lo  ==  (x - (xmin - lo/scale)) * scale
+    shift = xmin - lo / scale
+    return ScalerParams(shift=shift, scale=scale)
+
+
+def fit_standard(X: jnp.ndarray, eps: float = 1e-12) -> ScalerParams:
+    """Standard (z-score) scaler fit."""
+    mean = jnp.nanmean(X, axis=0)
+    std = jnp.sqrt(jnp.nanmean((X - mean) ** 2, axis=0))
+    std = jnp.where(std < eps, 1.0, std)
+    return ScalerParams(shift=mean, scale=1.0 / std)
+
+
+def identity_scaler(n_features: int) -> ScalerParams:
+    return ScalerParams(shift=jnp.zeros((n_features,)), scale=jnp.ones((n_features,)))
+
+
+def scaler_transform(params: ScalerParams, X: jnp.ndarray) -> jnp.ndarray:
+    return (X - params.shift) * params.scale
+
+
+def scaler_inverse_transform(params: ScalerParams, X: jnp.ndarray) -> jnp.ndarray:
+    return X / params.scale + params.shift

@@ -1,0 +1,28 @@
+"""Sliding-window construction for sequence models.
+
+Reference parity: the reference feeds LSTMs via Keras ``TimeseriesGenerator``
+with a ``lookback_window`` (gordo_components/model/models.py, unverified;
+SURVEY.md §2 "model.models"). TPU-native inversion: windows are materialized
+as a *batch* dimension with a gather — a static-shape op that XLA vectorizes
+— rather than a Python generator, so the windowed batch feeds the MXU
+directly and the whole train step stays inside one compiled program.
+"""
+
+import jax.numpy as jnp
+
+
+def num_windows(n_samples: int, lookback: int) -> int:
+    """Number of complete lookback windows in a series of ``n_samples``."""
+    return max(0, n_samples - lookback + 1)
+
+
+def sliding_windows(X: jnp.ndarray, lookback: int) -> jnp.ndarray:
+    """(n_samples, n_features) -> (n_windows, lookback, n_features).
+
+    Window ``i`` covers rows ``[i, i+lookback)``; static shapes throughout
+    (``lookback`` must be a Python int at trace time).
+    """
+    n = X.shape[0]
+    nw = num_windows(n, lookback)
+    idx = jnp.arange(nw)[:, None] + jnp.arange(lookback)[None, :]  # (nw, lookback)
+    return X[idx]

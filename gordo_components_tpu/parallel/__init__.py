@@ -1,0 +1,15 @@
+"""Many-model parallel engine.
+
+This package is the TPU-native inversion of the reference's one-pod-per-
+model Kubernetes fan-out (SURVEY.md §2 "Parallelism strategies", §7):
+thousands of small homogeneous autoencoders become a *stacked pytree*
+trained by ``vmap(train_step)`` over the model axis, sharded across a
+``jax.sharding.Mesh`` so each device trains its shard of the fleet with
+zero inter-device communication — many-model parallelism rides the
+compiler, not the cluster scheduler.
+"""
+
+from gordo_components_tpu.parallel.mesh import fleet_mesh, shard_model_axis
+from gordo_components_tpu.parallel.fleet import FleetTrainer, FleetMemberModel
+
+__all__ = ["fleet_mesh", "shard_model_axis", "FleetTrainer", "FleetMemberModel"]

@@ -1,0 +1,55 @@
+"""Data-parallel training step (single model, batch sharded).
+
+The north star names pmap-style DP over ICI for per-model batches
+(BASELINE.json). The modern JAX idiom is ``shard_map`` over a mesh ``data``
+axis: params replicated, batch sharded, gradients ``pmean``-ed across the
+axis — XLA lowers the pmean to an ICI all-reduce. Used when one machine's
+dataset is large enough to warrant intra-model parallelism (the fleet
+engine's model-axis sharding covers the many-model case).
+"""
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from gordo_components_tpu.ops.losses import mse_loss
+
+DATA_AXIS = "data"
+
+
+def data_mesh(n_devices=None) -> Mesh:
+    import numpy as np
+
+    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def make_dp_train_step(module, optimizer: optax.GradientTransformation, mesh: Mesh) -> Callable:
+    """Returns jit'd ``step(params, opt_state, xb, yb) ->
+    (params, opt_state, loss)`` with the batch dimension sharded over the
+    mesh ``data`` axis and gradients all-reduced (psum/pmean over ICI)."""
+
+    def loss_fn(params, xb, yb):
+        pred = module.apply(params, xb)
+        return mse_loss(pred, yb)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+    def sharded_step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(sharded_step, donate_argnums=(0, 1))

@@ -1,0 +1,89 @@
+"""Artifact persistence: model directory trees.
+
+Reference parity: serializer ``dump``/``load``/``load_metadata``
+(gordo_components/serializer/, unverified; SURVEY.md §2) — the reference
+persists a pipeline as a directory of pickled steps + Keras HDF5 +
+``metadata.json``. Here the artifact directory is:
+
+- ``model.pkl``      — the full (sklearn-compatible) object; our estimators
+                       carry numpy param pytrees so plain pickle is exact
+- ``params.npz``     — flax params flattened to ``a/b/c`` keys, saved
+                       language-neutrally for non-Python consumers
+- ``metadata.json``  — the build-metadata contract
+
+The unit of persistence is the *finished model artifact* exactly as in the
+reference (SURVEY.md §5 "Checkpoint/resume"); mid-training checkpointing of
+fleet state lives in parallel/ (orbax), not here.
+"""
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_MODEL_FILE = "model.pkl"
+_PARAMS_FILE = "params.npz"
+_METADATA_FILE = "metadata.json"
+
+
+def _flatten_params(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_params(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_params(v, f"{prefix}{i}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def dump(obj: Any, dest_dir: str, metadata: Optional[Dict] = None) -> None:
+    """Persist a model (pipeline/estimator/detector) into ``dest_dir``."""
+    os.makedirs(dest_dir, exist_ok=True)
+    with open(os.path.join(dest_dir, _MODEL_FILE), "wb") as f:
+        pickle.dump(obj, f)
+
+    params = _extract_params(obj)
+    if params:
+        np.savez(os.path.join(dest_dir, _PARAMS_FILE), **params)
+
+    if metadata is not None:
+        with open(os.path.join(dest_dir, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f, default=str, indent=2)
+
+
+def _extract_params(obj: Any) -> Dict[str, np.ndarray]:
+    """Find flax param pytrees on the object (estimator, pipeline step, or
+    anomaly wrapper) for the language-neutral npz."""
+    if getattr(obj, "params_", None) is not None:
+        return _flatten_params(obj.params_)
+    if hasattr(obj, "base_estimator"):
+        return _extract_params(obj.base_estimator)
+    if hasattr(obj, "steps"):
+        return _extract_params(obj.steps[-1][1])
+    return {}
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def load(source_dir: str) -> Any:
+    with open(os.path.join(source_dir, _MODEL_FILE), "rb") as f:
+        return pickle.load(f)
+
+
+def load_metadata(source_dir: str) -> Dict:
+    path = os.path.join(source_dir, _METADATA_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,192 @@
+"""Config-definition language: dotted import paths + nested kwargs.
+
+Reference parity: ``pipeline_from_definition`` /
+``pipeline_into_definition`` (gordo_components/serializer/, unverified;
+SURVEY.md §2). A definition is:
+
+- a string dotted path -> instantiate with defaults
+  (``sklearn.preprocessing.MinMaxScaler``)
+- a one-key dict ``{dotted.path: {kwargs}}`` -> instantiate with kwargs,
+  recursively resolving kwarg values that are themselves definitions
+- a list -> each element resolved (used for ``Pipeline(steps=...)`` and
+  ``FeatureUnion(transformer_list=...)``)
+
+``sklearn.pipeline.Pipeline`` steps and ``FeatureUnion`` transformer lists
+accept bare definitions and are auto-named ``step_0..`` exactly so the
+round-trip ``into_definition(from_definition(d)) == d``-modulo-names holds.
+"""
+
+import importlib
+import inspect
+import logging
+from typing import Any, Dict, List, Union
+
+logger = logging.getLogger(__name__)
+
+# Reference-era dotted paths -> this package. Any other `gordo_components.`
+# prefix falls back to a prefix rewrite.
+_PATH_ALIASES = {
+    "gordo_components.model.models.KerasAutoEncoder": "gordo_components_tpu.models.AutoEncoder",
+    "gordo_components.model.models.KerasLSTMAutoEncoder": "gordo_components_tpu.models.LSTMAutoEncoder",
+    "gordo_components.model.models.KerasLSTMForecast": "gordo_components_tpu.models.LSTMForecast",
+    "gordo_components.model.anomaly.DiffBasedAnomalyDetector": "gordo_components_tpu.models.DiffBasedAnomalyDetector",
+    "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector": "gordo_components_tpu.models.DiffBasedAnomalyDetector",
+}
+
+
+def import_locate(path: str) -> Any:
+    """Import an object from a dotted path, applying reference aliases."""
+    path = _PATH_ALIASES.get(path, path)
+    if path.startswith("gordo_components."):
+        path = "gordo_components_tpu." + path[len("gordo_components.") :]
+    module_path, _, name = path.rpartition(".")
+    if not module_path:
+        raise ImportError(f"Not a dotted path: {path!r}")
+    try:
+        module = importlib.import_module(module_path)
+        return getattr(module, name)
+    except AttributeError:
+        # maybe the "module" part is itself a class (nested attr)
+        parent = import_locate(module_path)
+        return getattr(parent, name)
+
+
+def _looks_like_path(key: Any) -> bool:
+    return isinstance(key, str) and "." in key
+
+
+def from_definition(definition: Union[str, Dict, List]) -> Any:
+    """Instantiate an object (usually an sklearn Pipeline) from a definition."""
+    if isinstance(definition, str):
+        if _looks_like_path(definition):
+            return import_locate(definition)()
+        raise ValueError(f"Cannot interpret definition string: {definition!r}")
+
+    if isinstance(definition, list):
+        return [from_definition(d) if _is_definition(d) else d for d in definition]
+
+    if isinstance(definition, dict):
+        if len(definition) != 1:
+            raise ValueError(
+                f"Definition dict must have exactly one dotted-path key, got {sorted(definition)}"
+            )
+        (path, kwargs), = definition.items()
+        cls = import_locate(path)
+        kwargs = dict(kwargs or {})
+        kwargs = {k: _resolve_value(k, v) for k, v in kwargs.items()}
+        return cls(**kwargs)
+
+    raise ValueError(f"Cannot interpret definition of type {type(definition)}")
+
+
+def _is_definition(v: Any) -> bool:
+    if isinstance(v, str) and _looks_like_path(v):
+        try:
+            import_locate(v)
+            return True
+        except Exception:
+            return False
+    if isinstance(v, dict) and len(v) == 1:
+        key = next(iter(v))
+        if _looks_like_path(key):
+            try:
+                import_locate(key)
+                return True
+            except Exception:
+                return False
+    return False
+
+
+def _resolve_value(key: str, value: Any) -> Any:
+    # steps / transformer_list entries may be bare definitions or
+    # (name, definition) pairs; auto-name bare entries
+    if key in ("steps", "transformer_list") and isinstance(value, list):
+        out = []
+        for i, entry in enumerate(value):
+            if isinstance(entry, (list, tuple)) and len(entry) == 2 and isinstance(entry[0], str) and not _is_definition(entry[0]):
+                out.append((entry[0], from_definition(entry[1]) if _is_definition(entry[1]) else entry[1]))
+            elif _is_definition(entry):
+                obj = from_definition(entry)
+                out.append((f"step_{i}", obj))
+            else:
+                out.append(entry)
+        return out
+    if _is_definition(value):
+        return from_definition(value)
+    if isinstance(value, list):
+        return [from_definition(v) if _is_definition(v) else v for v in value]
+    return value
+
+
+# ---------------------------------------------------------------------- #
+# inverse: object -> definition
+# ---------------------------------------------------------------------- #
+
+
+def _dotted_path(obj: Any) -> str:
+    cls = type(obj)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def _ctor_defaults(obj: Any) -> Dict[str, Any]:
+    try:
+        sig = inspect.signature(type(obj).__init__)
+        return {
+            k: p.default
+            for k, p in sig.parameters.items()
+            if p.default is not inspect.Parameter.empty
+        }
+    except (TypeError, ValueError):
+        return {}
+
+
+def into_definition(obj: Any, prune_defaults: bool = True) -> Union[str, Dict]:
+    """Serialize an object back into the definition language.
+
+    Uses ``capture_args``-captured params when present (our classes),
+    otherwise sklearn's ``get_params(deep=False)`` pruned to non-default
+    values so emitted configs stay human-sized.
+    """
+    path = _dotted_path(obj)
+
+    if hasattr(obj, "_params"):
+        params = dict(obj._params)
+    elif hasattr(obj, "get_params"):
+        params = obj.get_params(deep=False)
+        if prune_defaults:
+            defaults = _ctor_defaults(obj)
+            params = {
+                k: v
+                for k, v in params.items()
+                if not (k in defaults and _safe_eq(defaults[k], v))
+            }
+    else:
+        params = {}
+
+    params = {k: _encode_value(v) for k, v in params.items()}
+    if not params:
+        return path
+    return {path: params}
+
+
+def _safe_eq(a, b) -> bool:
+    try:
+        return bool(a == b)
+    except Exception:
+        return False
+
+
+def _encode_value(v: Any) -> Any:
+    # (name, estimator) tuples from Pipeline.steps / FeatureUnion
+    if isinstance(v, tuple) and len(v) == 2 and isinstance(v[0], str) and hasattr(v[1], "get_params"):
+        return [v[0], into_definition(v[1])]
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    if hasattr(v, "get_params") or hasattr(v, "_params"):
+        return into_definition(v)
+    return v
+
+
+# Reference-era function names
+pipeline_from_definition = from_definition
+pipeline_into_definition = into_definition

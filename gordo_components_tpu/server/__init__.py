@@ -1,0 +1,45 @@
+"""Model server.
+
+Reference parity: gordo_components/server/ (unverified; SURVEY.md §2
+"server") — the reference runs one Flask+gunicorn process per model. The
+TPU-native server is one aiohttp process serving a *collection* of models
+(a fleet shard resident in a chip's HBM), with the same per-target REST
+surface, so Ambassador-style routing by ``{target}`` still works.
+"""
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from gordo_components_tpu.server.model_io import ModelCollection
+from gordo_components_tpu.server.views import routes
+
+logger = logging.getLogger(__name__)
+
+
+def build_app(model_dir: str, target_name: Optional[str] = None) -> web.Application:
+    """App factory: loads the artifact(s) under ``model_dir`` once."""
+    app = web.Application(client_max_size=256 * 1024**2)
+    app["collection"] = ModelCollection(model_dir, target_name=target_name)
+    app.add_routes(routes)
+    return app
+
+
+def run_server(
+    model_dir: str,
+    host: str = "0.0.0.0",
+    port: int = 5555,
+    target_name: Optional[str] = None,
+) -> None:
+    """Blocking server entrypoint (reference: ``run_server`` /
+    ``Dockerfile-ModelServer`` CMD)."""
+    app = build_app(model_dir, target_name=target_name)
+    logger.info(
+        "Serving %d model(s) on %s:%d", len(app["collection"].models), host, port
+    )
+    web.run_app(app, host=host, port=port)
+
+
+__all__ = ["build_app", "run_server", "ModelCollection"]

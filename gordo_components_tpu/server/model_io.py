@@ -1,0 +1,60 @@
+"""Model loading for the server.
+
+Reference parity: gordo_components/server/model_io.py (unverified; SURVEY.md
+§2 "server") — the reference loads ONE artifact per server process (env
+``MODEL_LOCATION``). The TPU-native server instead serves a *collection*:
+a directory of per-machine artifact dirs loaded into one process so a whole
+fleet shares a chip's HBM (BASELINE.json config 5); a single artifact dir
+still works and behaves like the reference.
+"""
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from gordo_components_tpu import serializer
+
+logger = logging.getLogger(__name__)
+
+
+class ModelCollection:
+    """name -> (model, metadata) for every artifact under ``root``.
+
+    ``root`` may be a single artifact dir (containing ``model.pkl``) —
+    loaded under the name ``target_name or basename(root)`` — or a dir of
+    artifact subdirs, each loaded under its subdir name.
+    """
+
+    def __init__(self, root: str, target_name: Optional[str] = None):
+        self.root = root
+        self.models: Dict[str, Any] = {}
+        self.metadata: Dict[str, Dict] = {}
+        if os.path.exists(os.path.join(root, "model.pkl")):
+            name = target_name or os.path.basename(os.path.normpath(root))
+            self._load_one(name, root)
+        else:
+            for entry in sorted(os.listdir(root)):
+                path = os.path.join(root, entry)
+                if os.path.isdir(path) and os.path.exists(
+                    os.path.join(path, "model.pkl")
+                ):
+                    self._load_one(entry, path)
+        if not self.models:
+            raise FileNotFoundError(f"No model artifacts found under {root!r}")
+
+    def _load_one(self, name: str, path: str) -> None:
+        logger.info("Loading model %r from %s", name, path)
+        self.models[name] = serializer.load(path)
+        meta = serializer.load_metadata(path)
+        # serve the artifact's recorded name if present
+        meta.setdefault("name", name)
+        self.metadata[name] = meta
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.models
+
+    def __getitem__(self, name: str):
+        return self.models[name]
+
+    def names(self):
+        return sorted(self.models)

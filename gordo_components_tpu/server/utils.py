@@ -1,0 +1,97 @@
+"""Server (de)serialization helpers.
+
+Reference parity: gordo_components/server/utils.py (unverified; SURVEY.md §2
+"server") — extraction of X/y from request payloads and the
+multi-level-column DataFrame ⇄ nested-dict JSON contract used by
+``POST /anomaly/prediction`` and the bulk client.
+"""
+
+import io
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+def frame_to_dict(df: pd.DataFrame) -> Dict[str, Any]:
+    """Multi-level (or flat) column DataFrame -> nested JSON-able dict:
+    ``{"data": {top: {sub: [values]}}, "index": [...]}}``."""
+    data: Dict[str, Any] = {}
+    if isinstance(df.columns, pd.MultiIndex):
+        for top in df.columns.get_level_values(0).unique():
+            sub = df[top]
+            if isinstance(sub, pd.Series):
+                data[str(top)] = sub.tolist()
+            else:
+                data[str(top)] = {
+                    str(c): sub[c].tolist() for c in sub.columns
+                }
+    else:
+        for c in df.columns:
+            data[str(c)] = df[c].tolist()
+    index = df.index
+    if isinstance(index, pd.DatetimeIndex):
+        idx = [ts.isoformat() for ts in index]
+    else:
+        idx = index.tolist()
+    return {"data": data, "index": idx}
+
+
+def dict_to_frame(payload: Dict[str, Any]) -> pd.DataFrame:
+    """Inverse of ``frame_to_dict``."""
+    data = payload["data"]
+    index = payload.get("index")
+    columns = {}
+    multi = any(isinstance(v, dict) for v in data.values())
+    for top, v in data.items():
+        if isinstance(v, dict):
+            for sub, values in v.items():
+                columns[(top, sub)] = values
+        else:
+            columns[(top, "") if multi else top] = v
+    df = pd.DataFrame(columns)
+    if multi:
+        df.columns = pd.MultiIndex.from_tuples(df.columns)
+    if index is not None:
+        try:
+            df.index = pd.DatetimeIndex(pd.to_datetime(index, utc=True))
+        except (ValueError, TypeError):
+            df.index = index
+    return df
+
+
+def extract_x_y(
+    body: Optional[Dict[str, Any]],
+    raw: Optional[bytes] = None,
+    content_type: str = "application/json",
+) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+    """Parse request payload into (X, y) DataFrames.
+
+    JSON accepts ``{"X": [[...]] | {col: [...]}, "y": ..., "index": [...]}``;
+    parquet bodies (content-type x-parquet) are read directly (reference
+    supports both, SURVEY.md §2 "server").
+    """
+    if "parquet" in content_type:
+        df = pd.read_parquet(io.BytesIO(raw))
+        return df, None
+    if not body or "X" not in body:
+        raise ValueError("Request must contain 'X'")
+    X = _parse_matrix(body["X"], body.get("index"))
+    y = _parse_matrix(body["y"], body.get("index")) if body.get("y") is not None else None
+    return X, y
+
+
+def _parse_matrix(value, index=None) -> pd.DataFrame:
+    if isinstance(value, dict):
+        df = pd.DataFrame(value)
+    else:
+        arr = np.asarray(value, dtype="float32")
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        df = pd.DataFrame(arr)
+    if index is not None and len(index) == len(df):
+        try:
+            df.index = pd.DatetimeIndex(pd.to_datetime(index, utc=True))
+        except (ValueError, TypeError):
+            df.index = index
+    return df

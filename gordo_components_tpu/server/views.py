@@ -1,0 +1,147 @@
+"""HTTP views.
+
+Reference parity: gordo_components/server/views/ (unverified; SURVEY.md §2
+"server", §3.2) — REST surface per target:
+
+- ``GET  /gordo/v0/{project}/{target}/healthcheck``
+- ``GET  /gordo/v0/{project}/{target}/metadata``
+- ``POST /gordo/v0/{project}/{target}/prediction``
+- ``POST /gordo/v0/{project}/{target}/anomaly/prediction``
+- ``GET  /gordo/v0/{project}/{target}/download-model``
+
+plus collection-level ``GET /gordo/v0/{project}/models``. Implemented on
+aiohttp; model compute runs in a thread-pool executor so the event loop
+stays responsive while XLA executes.
+"""
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+import numpy as np
+import pandas as pd
+from aiohttp import web
+
+from gordo_components_tpu import __version__, serializer
+from gordo_components_tpu.server.utils import extract_x_y, frame_to_dict
+
+logger = logging.getLogger(__name__)
+
+routes = web.RouteTableDef()
+
+
+def _collection(request: web.Request):
+    return request.app["collection"]
+
+
+def _get_model(request: web.Request):
+    target = request.match_info["target"]
+    collection = _collection(request)
+    if target not in collection:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": f"No such model: {target}"}),
+            content_type="application/json",
+        )
+    return collection[target], collection.metadata[target]
+
+
+@routes.get("/gordo/v0/{project}/models")
+async def list_models(request: web.Request) -> web.Response:
+    return web.json_response(
+        {
+            "project": request.match_info["project"],
+            "models": _collection(request).names(),
+        }
+    )
+
+
+@routes.get("/gordo/v0/{project}/{target}/healthcheck")
+async def healthcheck(request: web.Request) -> web.Response:
+    _get_model(request)
+    return web.json_response({"gordo-server-version": __version__})
+
+
+@routes.get("/gordo/v0/{project}/{target}/metadata")
+async def metadata(request: web.Request) -> web.Response:
+    _, meta = _get_model(request)
+    return web.json_response(
+        {"endpoint-metadata": meta, "env": {"model_collection_dir": _collection(request).root}}
+    )
+
+
+@routes.get("/gordo/v0/{project}/{target}/download-model")
+async def download_model(request: web.Request) -> web.Response:
+    model, _ = _get_model(request)
+    data = serializer.dumps(model)
+    return web.Response(
+        body=data, content_type="application/octet-stream"
+    )
+
+
+async def _parse_request(request: web.Request):
+    content_type = request.content_type or "application/json"
+    if "parquet" in content_type:
+        raw = await request.read()
+        return extract_x_y(None, raw, content_type)
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "Expected JSON body with an X entry"}),
+            content_type="application/json",
+        )
+    return extract_x_y(body)
+
+
+@routes.post("/gordo/v0/{project}/{target}/prediction")
+async def prediction(request: web.Request) -> web.Response:
+    model, _ = _get_model(request)
+    try:
+        X, _y = await _parse_request(request)
+    except ValueError as exc:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": str(exc)}), content_type="application/json"
+        )
+    loop = asyncio.get_running_loop()
+    try:
+        output = await loop.run_in_executor(None, model.predict, X.values.astype("float32"))
+    except Exception as exc:  # surface model errors as 400s with detail
+        logger.exception("prediction failed")
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"{type(exc).__name__}: {exc}"}),
+            content_type="application/json",
+        )
+    out_index = X.index[len(X) - len(output):]
+    return web.json_response(
+        {
+            "data": np.asarray(output).tolist(),
+            "index": [str(i) for i in out_index],
+        }
+    )
+
+
+@routes.post("/gordo/v0/{project}/{target}/anomaly/prediction")
+async def anomaly_prediction(request: web.Request) -> web.Response:
+    model, _ = _get_model(request)
+    if not hasattr(model, "anomaly"):
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"error": "Model does not support anomaly scoring"}),
+            content_type="application/json",
+        )
+    try:
+        X, y = await _parse_request(request)
+    except ValueError as exc:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": str(exc)}), content_type="application/json"
+        )
+    loop = asyncio.get_running_loop()
+    try:
+        frame = await loop.run_in_executor(None, model.anomaly, X, y)
+    except Exception as exc:
+        logger.exception("anomaly scoring failed")
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"{type(exc).__name__}: {exc}"}),
+            content_type="application/json",
+        )
+    return web.json_response(frame_to_dict(frame))

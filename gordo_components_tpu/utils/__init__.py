@@ -1,0 +1,12 @@
+"""Cross-cutting utilities.
+
+Reference parity: ``gordo_components``'s ``capture_args`` decorator
+(gordo_components/dataset/data_provider/base.py, unverified — see
+SURVEY.md §2 "util"), which records constructor kwargs so that objects can
+be round-tripped through metadata / config definitions.
+"""
+
+from gordo_components_tpu.utils.capture import capture_args
+from gordo_components_tpu.utils.metadata import metadata_timestamp, package_version
+
+__all__ = ["capture_args", "metadata_timestamp", "package_version"]

@@ -1,0 +1,43 @@
+"""``capture_args``: record ``__init__`` kwargs for config round-tripping.
+
+Reference parity: gordo_components' ``capture_args`` decorator (unverified
+location, SURVEY.md §2): any class whose ``__init__`` is decorated gets a
+``_params`` dict holding the exact arguments it was constructed with, so the
+serializer can re-emit the object as a config definition and metadata can
+record how every component was configured.
+"""
+
+import functools
+import inspect
+from typing import Any, Callable, Dict
+
+
+def capture_args(init: Callable) -> Callable:
+    """Decorator for ``__init__`` methods: records call args into ``self._params``.
+
+    Positional args are resolved to their parameter names via the signature;
+    defaults for unpassed parameters are included so the captured dict is a
+    complete reconstruction recipe. ``**kwargs`` catch-alls are flattened in.
+    """
+
+    sig = inspect.signature(init)
+
+    @functools.wraps(init)
+    def wrapper(self, *args, **kwargs):
+        bound = sig.bind(self, *args, **kwargs)
+        bound.apply_defaults()
+        params: Dict[str, Any] = {}
+        for name, value in bound.arguments.items():
+            if name == "self":
+                continue
+            param = sig.parameters[name]
+            if param.kind is inspect.Parameter.VAR_KEYWORD:
+                params.update(value)
+            elif param.kind is inspect.Parameter.VAR_POSITIONAL:
+                params[name] = list(value)
+            else:
+                params[name] = value
+        self._params = params
+        return init(self, *args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,16 @@
+"""Metadata helpers: the build-metadata dict is the framework's observability
+contract (SURVEY.md §5 — "metadata-as-contract"), threaded from builder →
+artifact → server → watchman."""
+
+import datetime
+
+
+def metadata_timestamp() -> str:
+    """UTC ISO-8601 timestamp used in build metadata."""
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def package_version() -> str:
+    from gordo_components_tpu import __version__
+
+    return __version__

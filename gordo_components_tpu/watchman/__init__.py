@@ -1,0 +1,6 @@
+"""Watchman: fleet-health aggregation service (reference parity:
+gordo_components/watchman/, unverified — SURVEY.md §2, §3.5)."""
+
+from gordo_components_tpu.watchman.server import build_watchman_app, run_watchman
+
+__all__ = ["build_watchman_app", "run_watchman"]

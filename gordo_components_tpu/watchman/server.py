@@ -1,0 +1,131 @@
+"""Watchman service.
+
+Reference parity: gordo_components/watchman/server.py (unverified;
+SURVEY.md §2 "watchman", §3.5) — the in-tree fleet failure *detector*: for a
+project's target list, poll each model server's ``/healthcheck`` and
+``/metadata`` and serve the aggregate
+``{project_name, endpoints: [{endpoint, healthy, metadata}, ...]}``.
+
+TPU-native notes: with the collection server, many targets share one base
+URL; watchman discovers targets from ``GET /models`` when no explicit list
+is given, and polls with bounded concurrency on the shared event loop.
+Results are cached for ``refresh_interval`` seconds.
+"""
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from gordo_components_tpu import __version__
+
+logger = logging.getLogger(__name__)
+
+
+class WatchmanState:
+    def __init__(
+        self,
+        project: str,
+        base_url: str,
+        targets: Optional[List[str]] = None,
+        refresh_interval: float = 30.0,
+        parallelism: int = 20,
+    ):
+        self.project = project
+        self.base_url = base_url.rstrip("/")
+        self.targets = targets
+        self.refresh_interval = refresh_interval
+        self.parallelism = parallelism
+        self._cache: Optional[Dict[str, Any]] = None
+        self._cache_time = 0.0
+        self._lock = asyncio.Lock()
+
+    def _url(self, target: str, endpoint: str) -> str:
+        return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
+
+    async def _check_target(self, session, sem, target: str) -> Dict[str, Any]:
+        entry: Dict[str, Any] = {
+            "endpoint": f"/gordo/v0/{self.project}/{target}/",
+            "target": target,
+            "healthy": False,
+        }
+        async with sem:
+            try:
+                async with session.get(self._url(target, "healthcheck")) as resp:
+                    entry["healthy"] = resp.status == 200
+                if entry["healthy"]:
+                    async with session.get(self._url(target, "metadata")) as resp:
+                        if resp.status == 200:
+                            body = await resp.json()
+                            entry["endpoint-metadata"] = body.get("endpoint-metadata", {})
+            except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                logger.warning("healthcheck failed for %s: %s", target, exc)
+        return entry
+
+    async def snapshot(self) -> Dict[str, Any]:
+        async with self._lock:
+            now = time.monotonic()
+            if self._cache is not None and now - self._cache_time < self.refresh_interval:
+                return self._cache
+            timeout = aiohttp.ClientTimeout(total=30)
+            sem = asyncio.Semaphore(self.parallelism)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                targets = self.targets
+                if targets is None:
+                    try:
+                        async with session.get(
+                            f"{self.base_url}/gordo/v0/{self.project}/models"
+                        ) as resp:
+                            targets = (await resp.json())["models"]
+                    except Exception as exc:
+                        logger.warning("target discovery failed: %s", exc)
+                        targets = []
+                endpoints = await asyncio.gather(
+                    *(self._check_target(session, sem, t) for t in targets)
+                )
+            self._cache = {
+                "project_name": self.project,
+                "gordo-watchman-version": __version__,
+                "endpoints": list(endpoints),
+            }
+            self._cache_time = now
+            return self._cache
+
+
+def build_watchman_app(
+    project: str,
+    base_url: str,
+    targets: Optional[List[str]] = None,
+    refresh_interval: float = 30.0,
+) -> web.Application:
+    state = WatchmanState(project, base_url, targets, refresh_interval)
+    app = web.Application()
+    app["state"] = state
+
+    async def root(request: web.Request) -> web.Response:
+        return web.json_response(await state.snapshot())
+
+    async def healthcheck(request: web.Request) -> web.Response:
+        return web.json_response({"gordo-watchman-version": __version__})
+
+    app.router.add_get("/", root)
+    app.router.add_get("/healthcheck", healthcheck)
+    return app
+
+
+def run_watchman(
+    project: str,
+    base_url: str,
+    targets: Optional[List[str]] = None,
+    host: str = "0.0.0.0",
+    port: int = 5556,
+    refresh_interval: float = 30.0,
+) -> None:
+    web.run_app(
+        build_watchman_app(project, base_url, targets, refresh_interval),
+        host=host,
+        port=port,
+    )

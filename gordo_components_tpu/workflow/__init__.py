@@ -1,0 +1,19 @@
+"""Orchestration layer (reference parity: gordo_components/workflow/,
+unverified — SURVEY.md §2)."""
+
+from gordo_components_tpu.workflow.config import (
+    DEFAULT_MODEL_CONFIG,
+    Machine,
+    NormalizedConfig,
+)
+from gordo_components_tpu.workflow.scheduler import Gang, schedule_gangs
+from gordo_components_tpu.workflow.generator import generate_workflow
+
+__all__ = [
+    "NormalizedConfig",
+    "Machine",
+    "DEFAULT_MODEL_CONFIG",
+    "Gang",
+    "schedule_gangs",
+    "generate_workflow",
+]

@@ -1,0 +1,128 @@
+"""Fleet config normalization.
+
+Reference parity: ``NormalizedConfig`` / ``Machine``
+(gordo_components/workflow/, unverified; SURVEY.md §2 "workflow") — a
+single declarative YAML lists machines (name + dataset + optional model
+overrides); project-level defaults merge into each machine; the default
+model is the reference's MinMaxScaler → hourglass-autoencoder anomaly
+pipeline.
+"""
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+DEFAULT_MODEL_CONFIG: Dict[str, Any] = {
+    "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    "sklearn.preprocessing.MinMaxScaler",
+                    {
+                        "gordo_components_tpu.models.AutoEncoder": {
+                            "kind": "feedforward_hourglass"
+                        }
+                    },
+                ]
+            }
+        }
+    }
+}
+
+DEFAULT_DATASET_CONFIG: Dict[str, Any] = {"type": "TimeSeriesDataset"}
+
+
+@dataclass
+class Machine:
+    """One machine = one model to build (reference: ``Machine``)."""
+
+    name: str
+    dataset: Dict[str, Any]
+    model: Dict[str, Any] = field(default_factory=lambda: copy.deepcopy(DEFAULT_MODEL_CONFIG))
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    evaluation: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.name or "/" in self.name:
+            raise ValueError(f"Invalid machine name {self.name!r}")
+        if "tags" in self.dataset and "tag_list" not in self.dataset:
+            self.dataset["tag_list"] = self.dataset.pop("tags")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "dataset": self.dataset,
+            "model": self.model,
+            "metadata": self.metadata,
+            "evaluation": self.evaluation,
+        }
+
+
+def _deep_merge(base: Dict, override: Dict) -> Dict:
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+class NormalizedConfig:
+    """Merge project defaults into per-machine specs.
+
+    Accepts the reference-era schema::
+
+        machines:
+          - name: machine-1
+            dataset: {tags: [...], train_start_date: ..., train_end_date: ...}
+            model: {...}           # optional override
+            metadata: {...}
+        globals:                   # optional project defaults
+          model: {...}
+          dataset: {...}
+          runtime: {...}           # TPU gang-scheduling knobs (see scheduler)
+    """
+
+    def __init__(self, config: Union[str, Dict[str, Any]]):
+        if isinstance(config, str):
+            config = yaml.safe_load(config)
+        if not isinstance(config, dict) or "machines" not in config:
+            raise ValueError("Config must be a mapping with a 'machines' list")
+        self.raw = config
+        globals_ = config.get("globals", {}) or {}
+        default_model = globals_.get("model", DEFAULT_MODEL_CONFIG)
+        default_dataset = _deep_merge(
+            DEFAULT_DATASET_CONFIG, globals_.get("dataset", {}) or {}
+        )
+        default_metadata = globals_.get("metadata", {}) or {}
+        self.runtime: Dict[str, Any] = globals_.get("runtime", {}) or {}
+
+        self.machines: List[Machine] = []
+        seen = set()
+        for entry in config["machines"]:
+            if isinstance(entry, str):
+                entry = {"name": entry, "dataset": {}}
+            name = entry.get("name")
+            if name in seen:
+                raise ValueError(f"Duplicate machine name {name!r}")
+            seen.add(name)
+            machine = Machine(
+                name=name,
+                dataset=_deep_merge(default_dataset, entry.get("dataset", {}) or {}),
+                model=(
+                    copy.deepcopy(entry["model"])
+                    if entry.get("model")
+                    else copy.deepcopy(default_model)
+                ),
+                metadata=_deep_merge(default_metadata, entry.get("metadata", {}) or {}),
+                evaluation=copy.deepcopy(entry.get("evaluation", {}) or {}),
+            )
+            self.machines.append(machine)
+
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "NormalizedConfig":
+        with open(path) as f:
+            return cls(yaml.safe_load(f))

@@ -1,0 +1,71 @@
+"""Workflow generator: fleet config -> Kubernetes manifests.
+
+Reference parity: gordo_components/workflow/workflow_generator.py +
+templates/ (unverified; SURVEY.md §2 "workflow", §3.4) — pure in-process
+Jinja2 templating from normalized machine config to manifests on stdout.
+Where the reference renders an Argo Workflow with one builder pod per
+machine, this renders gang-scheduled TPU builder Jobs (see scheduler.py),
+one collection model-server Deployment per project, Ambassador mappings,
+and a Watchman deployment.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jinja2
+
+from gordo_components_tpu.workflow.config import NormalizedConfig
+from gordo_components_tpu.workflow.scheduler import schedule_gangs
+
+_TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "templates")
+
+DEFAULTS: Dict[str, Any] = {
+    "namespace": "gordo",
+    "builder_image": "gordo-components-tpu/builder:latest",
+    "server_image": "gordo-components-tpu/server:latest",
+    "tpu_accelerator": "tpu-v5-lite-podslice",
+    "tpu_topology": "2x4",
+    "server_tpu_topology": "2x4",
+    "server_devices": 8,
+    "server_replicas": 1,
+    "builder_retries": 3,
+    "artifact_root": "/gordo/models",
+    "artifact_pvc": "gordo-models",
+    "models_per_gang": 1024,
+    "devices_per_gang": 8,
+}
+
+
+def generate_workflow(
+    config: NormalizedConfig,
+    project_name: str,
+    **overrides: Any,
+) -> str:
+    """Render the full multi-document manifest YAML for a project."""
+    params = {**DEFAULTS, **(config.runtime or {}), **overrides}
+    gangs = schedule_gangs(
+        config.machines,
+        models_per_gang=int(params["models_per_gang"]),
+        devices_per_gang=int(params["devices_per_gang"]),
+    )
+    env = jinja2.Environment(
+        loader=jinja2.FileSystemLoader(_TEMPLATE_DIR),
+        undefined=jinja2.StrictUndefined,
+        keep_trailing_newline=True,
+    )
+    template = env.get_template("tpu-workflow.yaml.j2")
+    gang_ctx = [
+        {
+            "gang_id": g.gang_id,
+            "devices": g.devices,
+            "payload_json": json.dumps(g.to_manifest_payload(), default=str),
+        }
+        for g in gangs
+    ]
+    return template.render(
+        project_name=project_name,
+        n_machines=len(config.machines),
+        gangs=gang_ctx,
+        **{k: v for k, v in params.items() if k not in ("models_per_gang", "devices_per_gang")},
+    )

@@ -1,0 +1,62 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) so distributed/fleet paths
+are actually exercised in CI without TPU hardware — the improvement over
+the reference's YAML-only "distributed" tests called out in SURVEY.md §4.
+Env vars must be set before jax initializes, hence here at import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may preset a TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# a sitecustomize may have force-registered a TPU platform plugin and pinned
+# jax_platforms; re-pin to cpu before any backend is committed
+jax.config.update("jax_platforms", "cpu")
+
+import asyncio
+import inspect
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (no pytest-asyncio in the
+    image)."""
+    if inspect.iscoroutinefunction(pyfuncitem.function):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(pyfuncitem.function(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture(scope="session")
+def sensor_frame() -> pd.DataFrame:
+    """Small deterministic multi-tag frame used across model tests."""
+    rng = np.random.RandomState(42)
+    n = 200
+    t = np.arange(n)
+    data = {
+        f"tag-{i}": np.sin(0.05 * (i + 1) * t) + rng.normal(scale=0.05, size=n)
+        for i in range(4)
+    }
+    index = pd.date_range("2020-01-01", periods=n, freq="10min", tz="UTC")
+    return pd.DataFrame(data, index=index).astype("float32")
+
+
+@pytest.fixture(scope="session")
+def X(sensor_frame) -> np.ndarray:
+    return sensor_frame.values

@@ -1,0 +1,85 @@
+"""DiffBasedAnomalyDetector tests (reference parity, SURVEY.md §2
+"model.anomaly")."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_components_tpu.models import (
+    AutoEncoder,
+    DiffBasedAnomalyDetector,
+    LSTMAutoEncoder,
+)
+
+FAST = dict(epochs=2, batch_size=64)
+
+EXPECTED_TOPLEVEL = {
+    "model-input",
+    "model-output",
+    "tag-anomaly-scaled",
+    "tag-anomaly-unscaled",
+    "total-anomaly-scaled",
+    "total-anomaly-unscaled",
+}
+
+
+class TestDiffAnomaly:
+    def test_anomaly_frame_schema(self, sensor_frame):
+        det = DiffBasedAnomalyDetector(base_estimator=AutoEncoder(**FAST))
+        det.fit(sensor_frame)
+        adf = det.anomaly(sensor_frame)
+        assert set(adf.columns.get_level_values(0)) == EXPECTED_TOPLEVEL
+        assert len(adf) == len(sensor_frame)
+        assert (adf[("total-anomaly-scaled", "")] >= 0).all()
+        # per-tag columns present for each tag
+        for tag in sensor_frame.columns:
+            assert (("tag-anomaly-scaled", tag)) in adf.columns
+
+    def test_anomaly_with_pipeline_base(self, sensor_frame):
+        pipe = Pipeline(
+            [("scale", MinMaxScaler()), ("model", AutoEncoder(**FAST))]
+        )
+        det = DiffBasedAnomalyDetector(base_estimator=pipe)
+        det.fit(sensor_frame)
+        adf = det.anomaly(sensor_frame)
+        assert set(adf.columns.get_level_values(0)) == EXPECTED_TOPLEVEL
+
+    def test_sequence_base_alignment(self, sensor_frame):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=LSTMAutoEncoder(kind="lstm_model", dims=(8,), lookback_window=6, **FAST)
+        )
+        det.fit(sensor_frame)
+        adf = det.anomaly(sensor_frame)
+        # warm-up rows consumed by the lookback window
+        assert len(adf) == len(sensor_frame) - 6 + 1
+        # index preserved and aligned to window ends
+        assert adf.index[0] == sensor_frame.index[5]
+
+    def test_default_base_estimator(self):
+        det = DiffBasedAnomalyDetector()
+        assert isinstance(det.base_estimator, AutoEncoder)
+
+    def test_unfitted_raises(self, sensor_frame):
+        with pytest.raises(RuntimeError):
+            DiffBasedAnomalyDetector().anomaly(sensor_frame)
+
+    def test_thresholds_in_metadata(self, sensor_frame):
+        det = DiffBasedAnomalyDetector(base_estimator=AutoEncoder(**FAST))
+        det.fit(sensor_frame)
+        md = det.get_metadata()
+        assert "total-anomaly-threshold" in md
+        assert set(md["feature-thresholds"]) == set(sensor_frame.columns)
+
+    def test_outlier_scores_higher(self, sensor_frame):
+        """An obviously corrupted row should get a larger anomaly score."""
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(kind="feedforward_hourglass", epochs=15, batch_size=64)
+        )
+        det.fit(sensor_frame)
+        corrupted = sensor_frame.copy()
+        corrupted.iloc[50] = 50.0  # wild outlier
+        adf = det.anomaly(corrupted)
+        total = adf[("total-anomaly-scaled", "")]
+        assert total.iloc[50] > 5 * total.drop(total.index[50]).median()

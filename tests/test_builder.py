@@ -1,0 +1,133 @@
+"""Builder tests: build_model, metadata assembly, cache semantics
+(reference test strategy, SURVEY.md §4)."""
+
+import json
+import os
+
+import pytest
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.builder import (
+    build_model,
+    calculate_model_key,
+    provide_saved_model,
+)
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00Z",
+    "train_end_date": "2020-01-01T12:00:00Z",
+    "tag_list": ["a", "b", "c"],
+}
+
+MODEL_CONFIG = {
+    "gordo_components_tpu.models.AutoEncoder": {
+        "kind": "feedforward_hourglass",
+        "epochs": 2,
+        "batch_size": 64,
+    }
+}
+
+
+class TestBuildModel:
+    def test_build_and_metadata(self):
+        model, md = build_model("machine-1", MODEL_CONFIG, DATA_CONFIG, {"owner": "me"})
+        assert md["name"] == "machine-1"
+        assert md["model"]["trained"]
+        assert md["user-defined"] == {"owner": "me"}
+        assert md["dataset"]["rows_after_dropna"] > 0
+        assert "history" in md["model"]
+        json.dumps(md, default=str)
+        assert model.predict is not None
+
+    def test_cross_validation(self):
+        _, md = build_model(
+            "m",
+            MODEL_CONFIG,
+            DATA_CONFIG,
+            evaluation_config={"cross_validation": True, "n_splits": 2},
+        )
+        cv = md["model"]["cross-validation"]
+        assert len(cv["explained-variance"]["per-fold"]) == 2
+
+    def test_cross_val_only_skips_training(self):
+        _, md = build_model(
+            "m",
+            MODEL_CONFIG,
+            DATA_CONFIG,
+            evaluation_config={"cv_mode": "cross_val_only", "n_splits": 2},
+        )
+        assert not md["model"]["trained"]
+
+
+class TestCacheKey:
+    def test_deterministic(self):
+        k1 = calculate_model_key("m", MODEL_CONFIG, DATA_CONFIG)
+        k2 = calculate_model_key("m", MODEL_CONFIG, DATA_CONFIG)
+        assert k1 == k2
+
+    def test_sensitive_to_config(self):
+        other = {**MODEL_CONFIG}
+        other["gordo_components_tpu.models.AutoEncoder"] = {
+            **MODEL_CONFIG["gordo_components_tpu.models.AutoEncoder"],
+            "epochs": 3,
+        }
+        assert calculate_model_key("m", MODEL_CONFIG, DATA_CONFIG) != calculate_model_key(
+            "m", other, DATA_CONFIG
+        )
+
+    def test_sensitive_to_name(self):
+        assert calculate_model_key("m1", MODEL_CONFIG, DATA_CONFIG) != calculate_model_key(
+            "m2", MODEL_CONFIG, DATA_CONFIG
+        )
+
+
+class TestProvideSavedModel:
+    def test_build_save_load(self, tmp_path):
+        out = provide_saved_model(
+            "machine-1",
+            MODEL_CONFIG,
+            DATA_CONFIG,
+            output_dir=str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        model = serializer.load(out)
+        md = serializer.load_metadata(out)
+        assert md["name"] == "machine-1"
+        assert model is not None
+        # output_dir mirror exists
+        assert os.path.exists(tmp_path / "out" / "model.pkl")
+
+    def test_cache_hit(self, tmp_path, monkeypatch):
+        kwargs = dict(
+            model_config=MODEL_CONFIG,
+            data_config=DATA_CONFIG,
+            output_dir=str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        p1 = provide_saved_model("machine-1", **kwargs)
+
+        # second call must NOT rebuild: poison build_model to prove it
+        # (sys.modules lookup: the package attr `build_model` is the function)
+        import importlib
+
+        bm = importlib.import_module("gordo_components_tpu.builder.build_model")
+
+        def boom(*a, **k):
+            raise AssertionError("cache miss — build_model called again")
+
+        monkeypatch.setattr(bm, "build_model", boom)
+        p2 = provide_saved_model("machine-1", **kwargs)
+        assert p1 == p2
+
+    def test_replace_cache_rebuilds(self, tmp_path):
+        kwargs = dict(
+            model_config=MODEL_CONFIG,
+            data_config=DATA_CONFIG,
+            output_dir=str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        p1 = provide_saved_model("machine-1", **kwargs)
+        mtime = os.path.getmtime(os.path.join(p1, "model.pkl"))
+        p2 = provide_saved_model("machine-1", replace_cache=True, **kwargs)
+        assert os.path.getmtime(os.path.join(p2, "model.pkl")) >= mtime

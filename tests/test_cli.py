@@ -1,0 +1,114 @@
+"""CLI tests with click's CliRunner + env vars + tmpdir (reference test
+strategy, SURVEY.md §4)."""
+
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_components_tpu.cli.cli import EXIT_CONFIG_ERROR, gordo
+
+DATA_CONFIG = json.dumps(
+    {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00Z",
+        "train_end_date": "2020-01-01T06:00:00Z",
+        "tag_list": ["a", "b"],
+    }
+)
+MODEL_CONFIG = json.dumps(
+    {
+        "gordo_components_tpu.models.AutoEncoder": {
+            "kind": "feedforward_symmetric",
+            "dims": [4],
+            "epochs": 1,
+            "batch_size": 32,
+        }
+    }
+)
+
+
+@pytest.fixture()
+def runner():
+    return CliRunner()
+
+
+class TestBuild:
+    def test_build_via_env(self, runner, tmp_path):
+        env = {
+            "MACHINE_NAME": "m1",
+            "MODEL_CONFIG": MODEL_CONFIG,
+            "DATA_CONFIG": DATA_CONFIG,
+            "OUTPUT_DIR": str(tmp_path / "out"),
+        }
+        result = runner.invoke(gordo, ["build"], env=env)
+        assert result.exit_code == 0, result.output
+        assert os.path.exists(tmp_path / "out" / "model.pkl")
+
+    def test_build_bad_config_exit_code(self, runner, tmp_path):
+        env = {
+            "MACHINE_NAME": "m1",
+            "MODEL_CONFIG": json.dumps({"no.such.Class": {}}),
+            "DATA_CONFIG": DATA_CONFIG,
+            "OUTPUT_DIR": str(tmp_path),
+        }
+        result = runner.invoke(gordo, ["build"], env=env)
+        assert result.exit_code != 0
+
+
+class TestBuildFleet:
+    def test_build_fleet_from_file(self, runner, tmp_path):
+        payload = {
+            "machines": [
+                {"name": "m1", "dataset": json.loads(DATA_CONFIG)},
+                {"name": "m2", "dataset": json.loads(DATA_CONFIG)},
+            ]
+        }
+        machines_file = tmp_path / "machines.json"
+        machines_file.write_text(json.dumps(payload))
+        result = runner.invoke(
+            gordo,
+            [
+                "build-fleet",
+                "--machines-file", str(machines_file),
+                "--output-dir", str(tmp_path / "out"),
+            ],
+        )
+        assert result.exit_code == 0, result.output
+        assert os.path.exists(tmp_path / "out" / "m1" / "model.pkl")
+        assert os.path.exists(tmp_path / "out" / "m2" / "model.pkl")
+
+
+class TestWorkflowGenerate:
+    def test_generate(self, runner, tmp_path):
+        config = {
+            "machines": [
+                {
+                    "name": "m1",
+                    "dataset": {
+                        "tags": ["a", "b"],
+                        "train_start_date": "2020-01-01T00:00:00Z",
+                        "train_end_date": "2020-02-01T00:00:00Z",
+                    },
+                }
+            ]
+        }
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text(yaml.safe_dump(config))
+        result = runner.invoke(
+            gordo,
+            ["workflow", "generate", "-f", str(cfg_file), "-p", "proj"],
+        )
+        assert result.exit_code == 0, result.output
+        docs = [d for d in yaml.safe_load_all(result.output) if isinstance(d, dict)]
+        assert any(d.get("kind") == "Job" for d in docs)
+
+    def test_generate_bad_config(self, runner, tmp_path):
+        cfg_file = tmp_path / "bad.yaml"
+        cfg_file.write_text("globals: {}\n")
+        result = runner.invoke(
+            gordo, ["workflow", "generate", "-f", str(cfg_file), "-p", "proj"]
+        )
+        assert result.exit_code == EXIT_CONFIG_ERROR

@@ -1,0 +1,193 @@
+"""Dataset-layer tests: joining/resampling/row-filtering on synthetic
+frames, provider behavior (reference test strategy, SURVEY.md §4)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.dataset import (
+    RandomDataset,
+    SensorTag,
+    TimeSeriesDataset,
+    get_dataset,
+    join_timeseries,
+    normalize_sensor_tags,
+    pandas_filter_rows,
+)
+from gordo_components_tpu.dataset.data_provider import (
+    FileSystemProvider,
+    RandomDataProvider,
+)
+
+
+class TestSensorTag:
+    def test_normalize_forms(self):
+        tags = normalize_sensor_tags(
+            ["plain", ["named", "asset-1"], {"name": "dicted", "asset": "asset-2"}]
+        )
+        assert tags[0] == SensorTag("plain", None)
+        assert tags[1] == SensorTag("named", "asset-1")
+        assert tags[2] == SensorTag("dicted", "asset-2")
+
+    def test_default_asset(self):
+        (tag,) = normalize_sensor_tags(["t"], asset="a")
+        assert tag.asset == "a"
+
+
+class TestRowFilter:
+    def test_filters(self):
+        df = pd.DataFrame({"a": [1, 2, 3], "b": [10, 20, 30]})
+        out = pandas_filter_rows(df, "a > 1 & b < 30")
+        assert list(out["a"]) == [2]
+
+    def test_rejects_dunder(self):
+        df = pd.DataFrame({"a": [1]})
+        with pytest.raises(ValueError):
+            pandas_filter_rows(df, "__import__('os').system('true')")
+
+    def test_rejects_attribute_access(self):
+        df = pd.DataFrame({"a": [1]})
+        with pytest.raises(ValueError):
+            pandas_filter_rows(df, "a.real > 0")
+
+    def test_empty_filter_noop(self):
+        df = pd.DataFrame({"a": [1]})
+        assert pandas_filter_rows(df, "").equals(df)
+
+    def test_backtick_names_with_digits(self):
+        """Sensor-tag-shaped names (`TAG-1`) must pass the safety check."""
+        df = pd.DataFrame({"TAG-1": [1.0, -1.0], "TAG-2": [10.0, 200.0]})
+        out = pandas_filter_rows(df, "`TAG-1` > 0 & `TAG-2` < 100")
+        assert len(out) == 1
+
+
+class TestRandomProvider:
+    def test_deterministic(self):
+        p1 = RandomDataProvider(seed=1)
+        p2 = RandomDataProvider(seed=1)
+        start, end = pd.Timestamp("2020-01-01", tz="UTC"), pd.Timestamp("2020-01-02", tz="UTC")
+        tags = normalize_sensor_tags(["x", "y"])
+        for s1, s2 in zip(p1.load_series(start, end, tags), p2.load_series(start, end, tags)):
+            pd.testing.assert_series_equal(s1, s2)
+
+    def test_different_tags_different_series(self):
+        p = RandomDataProvider()
+        start, end = pd.Timestamp("2020-01-01", tz="UTC"), pd.Timestamp("2020-01-02", tz="UTC")
+        s = list(p.load_series(start, end, normalize_sensor_tags(["x", "y"])))
+        assert not np.allclose(s[0].values, s[1].values)
+
+    def test_bad_range_raises(self):
+        p = RandomDataProvider()
+        with pytest.raises(ValueError):
+            list(
+                p.load_series(
+                    pd.Timestamp("2020-01-02", tz="UTC"),
+                    pd.Timestamp("2020-01-01", tz="UTC"),
+                    normalize_sensor_tags(["x"]),
+                )
+            )
+
+
+class TestJoinTimeseries:
+    def test_resample_and_join(self):
+        idx1 = pd.date_range("2020-01-01", periods=120, freq="1min", tz="UTC")
+        idx2 = pd.date_range("2020-01-01", periods=24, freq="5min", tz="UTC")
+        s1 = pd.Series(np.arange(120.0), index=idx1, name="fast")
+        s2 = pd.Series(np.arange(24.0), index=idx2, name="slow")
+        df, meta = join_timeseries(
+            [s1, s2], idx1[0], idx1[-1] + pd.Timedelta("1min"), "10min"
+        )
+        assert list(df.columns) == ["fast", "slow"]
+        assert len(df) == 12
+        assert meta["fast"]["rows_raw"] == 120
+
+    def test_reference_era_resolution_accepted(self):
+        idx = pd.date_range("2020-01-01", periods=60, freq="1min", tz="UTC")
+        s = pd.Series(np.arange(60.0), index=idx, name="t")
+        df, _ = join_timeseries([s], idx[0], idx[-1], "10T")  # old pandas offset
+        assert len(df) == 6
+
+
+class TestTimeSeriesDataset:
+    def test_get_data_shapes(self):
+        ds = TimeSeriesDataset(
+            train_start_date="2020-01-01T00:00:00Z",
+            train_end_date="2020-01-01T12:00:00Z",
+            tag_list=["a", "b", "c"],
+            data_provider=RandomDataProvider(),
+            resolution="10min",
+        )
+        X, y = ds.get_data()
+        assert X.shape == (72, 3)
+        assert y is None
+
+    def test_target_tags(self):
+        ds = TimeSeriesDataset(
+            train_start_date="2020-01-01T00:00:00Z",
+            train_end_date="2020-01-01T06:00:00Z",
+            tag_list=["a", "b"],
+            target_tag_list=["c"],
+            data_provider=RandomDataProvider(),
+        )
+        X, y = ds.get_data()
+        assert list(X.columns) == ["a", "b"]
+        assert list(y.columns) == ["c"]
+        assert len(X) == len(y)
+
+    def test_row_filter(self):
+        ds = TimeSeriesDataset(
+            train_start_date="2020-01-01T00:00:00Z",
+            train_end_date="2020-01-02T00:00:00Z",
+            tag_list=["a"],
+            data_provider=RandomDataProvider(noise=0.0),
+            row_filter="`a` > 0",
+        )
+        X, _ = ds.get_data()
+        assert (X["a"] > 0).all()
+
+    def test_metadata(self):
+        ds = RandomDataset(tag_list=["a", "b"])
+        ds.get_data()
+        md = ds.get_metadata()
+        assert md["rows_after_dropna"] > 0
+        assert len(md["tag_list"]) == 2
+        import json
+
+        json.dumps(md)
+
+    def test_get_dataset_config(self):
+        ds = get_dataset(
+            {
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00Z",
+                "train_end_date": "2020-01-01T06:00:00Z",
+                "tag_list": ["a"],
+            }
+        )
+        assert isinstance(ds, RandomDataset)
+
+    def test_bad_dates_raise(self):
+        with pytest.raises(ValueError):
+            TimeSeriesDataset(
+                train_start_date="2020-01-02T00:00:00Z",
+                train_end_date="2020-01-01T00:00:00Z",
+                tag_list=["a"],
+            )
+
+
+class TestFileSystemProvider:
+    def test_csv_roundtrip(self, tmp_path):
+        idx = pd.date_range("2020-01-01", periods=50, freq="1min", tz="UTC")
+        pd.DataFrame({"ts": idx, "value": np.arange(50.0)}).to_csv(
+            tmp_path / "mytag.csv", index=False
+        )
+        provider = FileSystemProvider(str(tmp_path))
+        tags = normalize_sensor_tags(["mytag"])
+        assert provider.can_handle_tag(tags[0])
+        (series,) = list(provider.load_series(idx[0], idx[-1], tags))
+        assert len(series) == 49  # end-exclusive
+        assert series.name == "mytag"
+
+    def test_missing_tag(self, tmp_path):
+        provider = FileSystemProvider(str(tmp_path))
+        assert not provider.can_handle_tag(SensorTag("ghost"))

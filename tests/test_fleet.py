@@ -1,0 +1,139 @@
+"""Fleet-engine tests on the virtual 8-device CPU mesh — real many-model
+sharding exercised in CI, which the reference never did (SURVEY.md §4
+"multi-node without a cluster")."""
+
+import jax
+import numpy as np
+import pytest
+
+from gordo_components_tpu.parallel import FleetTrainer, fleet_mesh
+from gordo_components_tpu.parallel.mesh import MODEL_AXIS, pad_count_to_mesh
+
+
+def _member_data(n_members, rows=150, features=4, seed=0):
+    rng = np.random.RandomState(seed)
+    out = {}
+    for i in range(n_members):
+        t = np.arange(rows)
+        base = np.stack(
+            [np.sin(0.01 * (i + 1) * (j + 1) * t) for j in range(features)], axis=1
+        )
+        out[f"machine-{i}"] = (base + rng.normal(scale=0.05, size=base.shape)).astype(
+            "float32"
+        )
+    return out
+
+
+class TestMesh:
+    def test_eight_virtual_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_mesh_and_padding(self):
+        mesh = fleet_mesh()
+        assert mesh.shape[MODEL_AXIS] == 8
+        assert pad_count_to_mesh(9, mesh) == 16
+        assert pad_count_to_mesh(8, mesh) == 8
+
+
+class TestFleetTrainer:
+    def test_trains_all_members(self):
+        members = _member_data(10)
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(8, 4), epochs=3, batch_size=64
+        )
+        models = trainer.fit(members)
+        assert set(models) == set(members)
+        for name, m in models.items():
+            assert m.n_features == 4
+            assert len(m.history["loss"]) == 3
+            pred = m.predict(members[name])
+            assert pred.shape == members[name].shape
+            assert np.isfinite(pred).all()
+
+    def test_members_get_distinct_models(self):
+        members = _member_data(4)
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(8,), epochs=3, batch_size=64
+        )
+        models = trainer.fit(members)
+        p0 = models["machine-0"].predict(members["machine-0"])
+        p1 = models["machine-1"].predict(members["machine-0"])
+        assert not np.allclose(p0, p1)
+
+    def test_heterogeneous_feature_counts_bucketed(self):
+        members = _member_data(3, features=4)
+        members.update(
+            {f"wide-{i}": np.random.RandomState(i).rand(150, 6).astype("float32") for i in range(3)}
+        )
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(8,), epochs=2, batch_size=64
+        )
+        models = trainer.fit(members)
+        assert models["machine-0"].n_features == 4
+        assert models["wide-0"].n_features == 6
+        assert len(trainer.last_stats["buckets"]) == 2
+
+    def test_heterogeneous_row_counts_padded(self):
+        members = {
+            "short": np.random.RandomState(0).rand(40, 3).astype("float32"),
+            "long": np.random.RandomState(1).rand(200, 3).astype("float32"),
+        }
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(4,), epochs=2, batch_size=64
+        )
+        models = trainer.fit(members)
+        assert set(models) == {"short", "long"}
+
+    def test_early_stopping_freezes_models(self):
+        members = _member_data(2)
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric",
+            dims=(8,),
+            epochs=40,
+            batch_size=64,
+            early_stopping_patience=2,
+        )
+        models = trainer.fit(members)
+        # histories must be allowed to be shorter than epochs
+        for m in models.values():
+            assert len(m.history["loss"]) <= 40
+
+    def test_fleet_vs_single_loss_comparable(self):
+        """A fleet-trained model must learn as well as a single train run of
+        the same architecture/epochs (same math, different batching axis)."""
+        members = _member_data(1)
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(8, 4), epochs=8, batch_size=64, seed=1
+        )
+        models = trainer.fit(members)
+        fleet_final = models["machine-0"].history["loss"][-1]
+
+        from gordo_components_tpu.models import AutoEncoder
+        from sklearn.preprocessing import MinMaxScaler
+
+        X = MinMaxScaler().fit_transform(members["machine-0"])
+        single = AutoEncoder(
+            kind="feedforward_symmetric", dims=(8, 4), epochs=8, batch_size=64, seed=1
+        )
+        single.fit(X.astype("float32"))
+        single_final = single.history["loss"][-1]
+        assert fleet_final == pytest.approx(single_final, rel=1.0)  # same ballpark
+
+    def test_to_estimator_produces_anomaly_detector(self, sensor_frame):
+        members = {"m": sensor_frame.values}
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(8,), epochs=2, batch_size=64
+        )
+        models = trainer.fit(members)
+        det = models["m"].to_estimator()
+        adf = det.anomaly(sensor_frame.values)
+        assert ("total-anomaly-scaled", "") in adf.columns
+
+    def test_sharding_over_mesh(self):
+        """Stacked arrays must actually shard over the models axis."""
+        mesh = fleet_mesh()
+        from gordo_components_tpu.parallel.mesh import shard_model_axis
+
+        x = np.zeros((16, 4), dtype=np.float32)
+        sharded = jax.device_put(x, shard_model_axis(mesh))
+        assert len(sharded.sharding.device_set) == 8

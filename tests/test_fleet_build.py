@@ -1,0 +1,174 @@
+"""Fleet-build bridge tests: fleetable-config detection and the gang build
+path end-to-end on RandomDataset data."""
+
+import os
+
+import pytest
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.builder.fleet_build import build_fleet, extract_fleetable
+from gordo_components_tpu.workflow.config import DEFAULT_MODEL_CONFIG, Machine
+
+DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00Z",
+    "train_end_date": "2020-01-01T12:00:00Z",
+    "tag_list": ["a", "b", "c"],
+}
+
+FLEETABLE = {
+    "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    "sklearn.preprocessing.MinMaxScaler",
+                    {
+                        "gordo_components_tpu.models.AutoEncoder": {
+                            "kind": "feedforward_symmetric",
+                            "dims": [8],
+                            "epochs": 2,
+                            "batch_size": 64,
+                        }
+                    },
+                ]
+            }
+        }
+    }
+}
+
+
+class TestExtractFleetable:
+    def test_default_config_is_fleetable(self):
+        kwargs = extract_fleetable(DEFAULT_MODEL_CONFIG)
+        assert kwargs == {"kind": "feedforward_hourglass"}
+
+    def test_custom_kwargs_extracted(self):
+        kwargs = extract_fleetable(FLEETABLE)
+        assert kwargs["kind"] == "feedforward_symmetric"
+        assert kwargs["epochs"] == 2
+
+    def test_bespoke_config_not_fleetable(self):
+        bespoke = {
+            "gordo_components_tpu.models.LSTMAutoEncoder": {"lookback_window": 8}
+        }
+        assert extract_fleetable(bespoke) is None
+
+    def test_reference_era_paths_fleetable(self):
+        old = {
+            "gordo_components.model.anomaly.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_components.model.models.KerasAutoEncoder": {
+                                    "kind": "feedforward_hourglass"
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+        assert extract_fleetable(old) == {"kind": "feedforward_hourglass"}
+
+    def test_detector_overrides_not_fleetable(self):
+        """Extra detector kwargs must force the single-build path (the fleet
+        engine builds a default detector)."""
+        cfg = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": FLEETABLE[
+                    "gordo_components_tpu.models.DiffBasedAnomalyDetector"
+                ]["base_estimator"],
+                "threshold_quantile": 0.99,
+            }
+        }
+        assert extract_fleetable(cfg) is None
+
+    def test_unscaled_pipeline_not_fleetable(self):
+        """A pipeline without a scaler step must not be silently min-max
+        scaled by the fleet engine."""
+        cfg = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            {"gordo_components_tpu.models.AutoEncoder": {"epochs": 1}}
+                        ]
+                    }
+                }
+            }
+        }
+        assert extract_fleetable(cfg) is None
+        # bare base estimator likewise
+        bare = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_components_tpu.models.AutoEncoder": {"epochs": 1}
+                }
+            }
+        }
+        assert extract_fleetable(bare) is None
+
+
+class TestBuildFleet:
+    def _machines(self, n):
+        return [
+            Machine(name=f"machine-{i}", dataset=dict(DATASET), model=FLEETABLE)
+            for i in range(n)
+        ]
+
+    def test_fleet_path_builds_artifacts(self, tmp_path):
+        machines = self._machines(3)
+        results = build_fleet(
+            machines,
+            str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        assert set(results) == {"machine-0", "machine-1", "machine-2"}
+        for name, path in results.items():
+            model = serializer.load(path)
+            md = serializer.load_metadata(path)
+            assert md["model"]["fleet_trained"]
+            assert md["name"] == name
+            # loaded artifact scores anomalies like a single-built one
+            import numpy as np
+
+            adf = model.anomaly(np.random.rand(20, 3).astype("float32"))
+            assert ("total-anomaly-scaled", "") in adf.columns
+            # real tag names (not feature-i) flow through the fleet path
+            assert model.tags_ == ["a", "b", "c"]
+            # mirrored into output_dir for the serving volume
+            assert os.path.exists(tmp_path / "out" / name / "model.pkl")
+
+    def test_cache_hit_on_rerun(self, tmp_path):
+        machines = self._machines(2)
+        kwargs = dict(
+            output_dir=str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        r1 = build_fleet(machines, **kwargs)
+        mtimes = {
+            n: os.path.getmtime(os.path.join(p, "model.pkl")) for n, p in r1.items()
+        }
+        r2 = build_fleet(machines, **kwargs)
+        assert r1 == r2
+        for n, p in r2.items():
+            assert os.path.getmtime(os.path.join(p, "model.pkl")) == mtimes[n]
+
+    def test_mixed_fleet_and_bespoke(self, tmp_path):
+        machines = self._machines(2)
+        machines.append(
+            Machine(
+                name="bespoke",
+                dataset=dict(DATASET),
+                model={
+                    "gordo_components_tpu.models.AutoEncoder": {
+                        "epochs": 1,
+                        "batch_size": 64,
+                    }
+                },
+            )
+        )
+        results = build_fleet(machines, str(tmp_path / "out"))
+        assert set(results) == {"machine-0", "machine-1", "bespoke"}

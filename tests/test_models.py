@@ -1,0 +1,141 @@
+"""Model-layer tests: parametrized over estimator classes and registered
+kinds, trained a few epochs on tiny arrays (reference test strategy,
+SURVEY.md §4)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.models import (
+    AutoEncoder,
+    ConvAutoEncoder,
+    KerasAutoEncoder,
+    LSTMAutoEncoder,
+    LSTMForecast,
+)
+from gordo_components_tpu.models.register import FACTORY_REGISTRY, lookup_factory
+
+
+FAST = dict(epochs=2, batch_size=64)
+
+
+class TestRegistry:
+    def test_expected_factories_registered(self):
+        assert {"feedforward_model", "feedforward_symmetric", "feedforward_hourglass",
+                "feedforward_variational"} <= set(FACTORY_REGISTRY["AutoEncoder"])
+        assert {"lstm_model", "lstm_symmetric", "lstm_hourglass",
+                "conv1d_autoencoder"} <= set(FACTORY_REGISTRY["LSTMAutoEncoder"])
+
+    def test_reference_alias_names(self):
+        # reference-era estimator names resolve to our registries
+        assert lookup_factory("KerasAutoEncoder", "feedforward_hourglass")
+        assert lookup_factory("KerasLSTMAutoEncoder", "lstm_hourglass")
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="Unknown kind"):
+            AutoEncoder(kind="nope")
+
+    def test_keras_alias_is_autoencoder(self):
+        assert KerasAutoEncoder is AutoEncoder
+
+
+class TestAutoEncoder:
+    @pytest.mark.parametrize(
+        "kind,kwargs",
+        [
+            ("feedforward_model", dict(encoding_dim=(16, 8), decoding_dim=(8, 16))),
+            ("feedforward_symmetric", dict(dims=(16, 8))),
+            ("feedforward_hourglass", {}),
+            ("feedforward_variational", dict(dims=(16,), latent_dim=4)),
+        ],
+    )
+    def test_fit_predict_score(self, X, kind, kwargs):
+        model = AutoEncoder(kind=kind, **FAST, **kwargs)
+        model.fit(X)
+        pred = model.predict(X)
+        assert pred.shape == X.shape
+        assert np.isfinite(pred).all()
+        assert len(model.history["loss"]) == 2
+        assert isinstance(model.score(X), float)
+
+    def test_loss_decreases(self, X):
+        model = AutoEncoder(kind="feedforward_hourglass", epochs=10, batch_size=64)
+        model.fit(X)
+        losses = model.history["loss"]
+        assert losses[-1] < losses[0]
+
+    def test_pickle_roundtrip_exact(self, X):
+        model = AutoEncoder(kind="feedforward_symmetric", dims=(8,), **FAST)
+        model.fit(X)
+        clone = pickle.loads(pickle.dumps(model))
+        np.testing.assert_allclose(clone.predict(X), model.predict(X), atol=1e-6)
+
+    def test_validation_split_and_early_stopping(self, X):
+        model = AutoEncoder(
+            kind="feedforward_hourglass",
+            epochs=30,
+            batch_size=64,
+            validation_split=0.2,
+            early_stopping_patience=2,
+        )
+        model.fit(X)
+        assert "val_loss" in model.history
+        # early stopping must be able to cut training short
+        assert len(model.history["loss"]) <= 30
+
+    def test_metadata(self, X):
+        model = AutoEncoder(**FAST)
+        model.fit(X)
+        md = model.get_metadata()
+        assert md["kind"] == "feedforward_hourglass"
+        assert md["n_features"] == X.shape[1]
+        assert md["parameter_count"] > 0
+        import json
+
+        json.dumps(md)  # must be JSON-serializable
+
+    def test_dataframe_input(self, sensor_frame):
+        model = AutoEncoder(**FAST)
+        model.fit(sensor_frame)
+        assert model.predict(sensor_frame).shape == sensor_frame.shape
+
+
+class TestSequenceModels:
+    @pytest.mark.parametrize("kind", ["lstm_model", "lstm_symmetric", "lstm_hourglass"])
+    def test_lstm_autoencoder_shapes(self, X, kind):
+        kwargs = {} if kind == "lstm_hourglass" else {"dims": (8,)}
+        model = LSTMAutoEncoder(kind=kind, lookback_window=6, **FAST, **kwargs)
+        model.fit(X)
+        pred = model.predict(X)
+        # reconstruction of the current step: n - lookback + 1 rows
+        assert pred.shape == (X.shape[0] - 6 + 1, X.shape[1])
+
+    def test_forecast_offset(self, X):
+        model = LSTMForecast(kind="lstm_model", lookback_window=6, dims=(8,), **FAST)
+        model.fit(X)
+        pred = model.predict(X)
+        # forecasting t+1: one fewer prediction than the autoencoder
+        assert pred.shape == (X.shape[0] - 6, X.shape[1])
+        assert isinstance(model.score(X), float)
+
+    def test_conv_autoencoder(self, X):
+        model = ConvAutoEncoder(lookback_window=8, channels=(8, 4), **FAST)
+        model.fit(X)
+        pred = model.predict(X)
+        assert pred.shape == (X.shape[0] - 8 + 1, X.shape[1])
+
+    def test_too_short_series_raises(self):
+        model = LSTMAutoEncoder(lookback_window=50, **FAST)
+        with pytest.raises(ValueError, match="lookback"):
+            model.fit(np.random.rand(10, 2).astype("float32"))
+
+    def test_lookback_captured_in_params(self):
+        model = LSTMAutoEncoder(lookback_window=12, **FAST)
+        assert model.get_params()["lookback_window"] == 12
+
+
+class TestUnfitted:
+    def test_predict_unfitted_raises(self):
+        with pytest.raises(RuntimeError, match="not been fitted"):
+            AutoEncoder().predict(np.zeros((3, 2), dtype="float32"))

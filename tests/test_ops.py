@@ -1,0 +1,95 @@
+"""Unit tests for the pure-JAX op layer."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_components_tpu.ops import (
+    explained_variance,
+    fit_minmax,
+    fit_standard,
+    identity_scaler,
+    mse_loss,
+    num_windows,
+    scaler_inverse_transform,
+    scaler_transform,
+    sliding_windows,
+)
+
+
+class TestScalers:
+    def test_minmax_matches_sklearn(self):
+        from sklearn.preprocessing import MinMaxScaler
+
+        rng = np.random.RandomState(0)
+        X = rng.rand(50, 3).astype("float32") * 10 - 5
+        ours = scaler_transform(fit_minmax(jnp.asarray(X)), jnp.asarray(X))
+        theirs = MinMaxScaler().fit_transform(X)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+
+    def test_minmax_feature_range(self):
+        X = jnp.asarray(np.random.RandomState(1).rand(20, 2).astype("float32"))
+        p = fit_minmax(X, feature_range=(-1.0, 1.0))
+        out = np.asarray(scaler_transform(p, X))
+        assert out.min() >= -1 - 1e-5 and out.max() <= 1 + 1e-5
+        assert np.isclose(out.min(), -1, atol=1e-5)
+
+    def test_standard_matches_sklearn(self):
+        from sklearn.preprocessing import StandardScaler
+
+        rng = np.random.RandomState(2)
+        X = rng.rand(50, 3).astype("float32")
+        ours = scaler_transform(fit_standard(jnp.asarray(X)), jnp.asarray(X))
+        theirs = StandardScaler().fit_transform(X)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4)
+
+    def test_inverse_roundtrip(self):
+        X = jnp.asarray(np.random.RandomState(3).rand(30, 4).astype("float32"))
+        p = fit_minmax(X)
+        back = scaler_inverse_transform(p, scaler_transform(p, X))
+        np.testing.assert_allclose(np.asarray(back), np.asarray(X), atol=1e-5)
+
+    def test_constant_feature_no_nan(self):
+        X = jnp.ones((10, 2))
+        out = np.asarray(scaler_transform(fit_minmax(X), X))
+        assert np.isfinite(out).all()
+
+    def test_identity(self):
+        X = jnp.asarray(np.random.rand(5, 3).astype("float32"))
+        p = identity_scaler(3)
+        np.testing.assert_allclose(np.asarray(scaler_transform(p, X)), np.asarray(X))
+
+
+class TestWindows:
+    def test_shapes(self):
+        X = jnp.arange(20.0).reshape(10, 2)
+        W = sliding_windows(X, 4)
+        assert W.shape == (7, 4, 2)
+        assert num_windows(10, 4) == 7
+
+    def test_content(self):
+        X = jnp.arange(10.0).reshape(10, 1)
+        W = np.asarray(sliding_windows(X, 3))
+        np.testing.assert_allclose(W[0, :, 0], [0, 1, 2])
+        np.testing.assert_allclose(W[-1, :, 0], [7, 8, 9])
+
+
+class TestLosses:
+    def test_mse_mask_ignores_padding(self):
+        pred = jnp.zeros((4, 2))
+        target = jnp.ones((4, 2))
+        mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        # padded rows have huge error; mask must exclude them
+        target = target.at[2:].set(100.0)
+        loss = float(mse_loss(pred, target, mask))
+        assert loss == pytest.approx(1.0)
+
+    def test_explained_variance_matches_sklearn(self):
+        from sklearn.metrics import explained_variance_score
+
+        rng = np.random.RandomState(4)
+        y = rng.rand(40, 3).astype("float32")
+        p = y + rng.normal(scale=0.1, size=y.shape).astype("float32")
+        ours = float(explained_variance(jnp.asarray(y), jnp.asarray(p)))
+        theirs = explained_variance_score(y, p)
+        assert ours == pytest.approx(theirs, abs=1e-4)

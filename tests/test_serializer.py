@@ -1,0 +1,111 @@
+"""Serializer tests: definition round-trips and artifact dump/load
+(reference test strategy, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+
+
+PIPE_DEF = {
+    "sklearn.pipeline.Pipeline": {
+        "steps": [
+            "sklearn.preprocessing.MinMaxScaler",
+            {
+                "gordo_components_tpu.models.AutoEncoder": {
+                    "kind": "feedforward_symmetric",
+                    "dims": [8, 4],
+                    "epochs": 1,
+                    "batch_size": 64,
+                }
+            },
+        ]
+    }
+}
+
+
+class TestFromDefinition:
+    def test_basic_pipeline(self):
+        pipe = serializer.from_definition(PIPE_DEF)
+        assert isinstance(pipe, Pipeline)
+        assert isinstance(pipe.steps[0][1], MinMaxScaler)
+        assert isinstance(pipe.steps[1][1], AutoEncoder)
+        assert pipe.steps[1][1].kind == "feedforward_symmetric"
+
+    def test_named_steps(self):
+        d = {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    ["scale", "sklearn.preprocessing.MinMaxScaler"],
+                    ["model", {"gordo_components_tpu.models.AutoEncoder": {"epochs": 1}}],
+                ]
+            }
+        }
+        pipe = serializer.from_definition(d)
+        assert [n for n, _ in pipe.steps] == ["scale", "model"]
+
+    def test_nested_estimator_kwarg(self):
+        d = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_components_tpu.models.AutoEncoder": {"epochs": 1}
+                }
+            }
+        }
+        det = serializer.from_definition(d)
+        assert isinstance(det, DiffBasedAnomalyDetector)
+        assert isinstance(det.base_estimator, AutoEncoder)
+
+    def test_reference_era_paths_aliased(self):
+        d = {"gordo_components.model.models.KerasAutoEncoder": {"epochs": 1}}
+        assert isinstance(serializer.from_definition(d), AutoEncoder)
+
+    def test_bad_definition_raises(self):
+        with pytest.raises((ImportError, ValueError, ModuleNotFoundError)):
+            serializer.from_definition({"not.a.real.Class": {}})
+
+
+class TestIntoDefinition:
+    def test_roundtrip_idempotent(self):
+        pipe = serializer.from_definition(PIPE_DEF)
+        d1 = serializer.into_definition(pipe)
+        pipe2 = serializer.from_definition(d1)
+        d2 = serializer.into_definition(pipe2)
+        assert d1 == d2
+
+    def test_sklearn_defaults_pruned(self):
+        d = serializer.into_definition(MinMaxScaler())
+        assert d == "sklearn.preprocessing._data.MinMaxScaler"
+
+
+class TestArtifacts:
+    def test_dump_load_predictions_equal(self, X, tmp_path):
+        pipe = serializer.from_definition(PIPE_DEF)
+        pipe.fit(X)
+        pred1 = pipe.predict(X)
+        serializer.dump(pipe, str(tmp_path / "art"), metadata={"name": "m1"})
+        loaded = serializer.load(str(tmp_path / "art"))
+        np.testing.assert_allclose(loaded.predict(X), pred1, atol=1e-6)
+
+    def test_metadata_roundtrip(self, tmp_path):
+        model = AutoEncoder(epochs=1)
+        serializer.dump(model, str(tmp_path / "art"), metadata={"k": 1})
+        assert serializer.load_metadata(str(tmp_path / "art")) == {"k": 1}
+
+    def test_params_npz_written(self, X, tmp_path):
+        model = AutoEncoder(epochs=1, batch_size=64)
+        model.fit(X)
+        serializer.dump(model, str(tmp_path / "art"))
+        import numpy as np_
+
+        archive = np_.load(str(tmp_path / "art" / "params.npz"))
+        assert len(archive.files) > 0
+
+    def test_dumps_loads_bytes(self, X):
+        model = AutoEncoder(epochs=1, batch_size=64)
+        model.fit(X)
+        clone = serializer.loads(serializer.dumps(model))
+        np.testing.assert_allclose(clone.predict(X), model.predict(X), atol=1e-6)

@@ -1,0 +1,124 @@
+"""Server tests, in-process via aiohttp's test utilities against small
+models trained in a fixture (reference strategy: Flask test_client, SURVEY.md
+§4). Async tests are run by the conftest ``pytest_pyfunc_call`` hook."""
+
+import contextlib
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+from gordo_components_tpu.server import build_app
+from gordo_components_tpu.server.utils import dict_to_frame, frame_to_dict
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory):
+    """Two artifacts under one collection root: an anomaly detector and a
+    plain estimator."""
+    rng = np.random.RandomState(0)
+    Xv = rng.rand(200, 3).astype("float32")
+    root = tmp_path_factory.mktemp("collection")
+
+    det = DiffBasedAnomalyDetector(base_estimator=AutoEncoder(epochs=2, batch_size=64))
+    det.fit(Xv)
+    serializer.dump(det, str(root / "machine-a"), metadata={"name": "machine-a"})
+
+    ae = AutoEncoder(epochs=2, batch_size=64)
+    ae.fit(Xv)
+    serializer.dump(ae, str(root / "machine-b"), metadata={"name": "machine-b"})
+    return str(root)
+
+
+@contextlib.asynccontextmanager
+async def make_client(artifact_dir):
+    client = TestClient(TestServer(build_app(artifact_dir)))
+    await client.start_server()
+    try:
+        yield client
+    finally:
+        await client.close()
+
+
+def _x_payload(n=20, f=3):
+    rng = np.random.RandomState(1)
+    return {"X": rng.rand(n, f).tolist()}
+
+
+async def test_list_models(artifact_dir):
+    async with make_client(artifact_dir) as client:
+        resp = await client.get("/gordo/v0/proj/models")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["models"] == ["machine-a", "machine-b"]
+
+
+async def test_healthcheck_and_404(artifact_dir):
+    async with make_client(artifact_dir) as client:
+        resp = await client.get("/gordo/v0/proj/machine-a/healthcheck")
+        assert resp.status == 200
+        assert "gordo-server-version" in await resp.json()
+        resp = await client.get("/gordo/v0/proj/ghost/healthcheck")
+        assert resp.status == 404
+
+
+async def test_metadata(artifact_dir):
+    async with make_client(artifact_dir) as client:
+        resp = await client.get("/gordo/v0/proj/machine-a/metadata")
+        body = await resp.json()
+        assert body["endpoint-metadata"]["name"] == "machine-a"
+
+
+async def test_prediction_and_bad_body(artifact_dir):
+    async with make_client(artifact_dir) as client:
+        resp = await client.post(
+            "/gordo/v0/proj/machine-b/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert np.asarray(body["data"]).shape == (20, 3)
+
+        resp = await client.post(
+            "/gordo/v0/proj/machine-b/prediction", json={"nope": 1}
+        )
+        assert resp.status == 400
+
+
+async def test_anomaly_prediction(artifact_dir):
+    async with make_client(artifact_dir) as client:
+        resp = await client.post(
+            "/gordo/v0/proj/machine-a/anomaly/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+        frame = dict_to_frame(await resp.json())
+        assert ("total-anomaly-scaled", "") in frame.columns
+        assert len(frame) == 20
+
+        # plain estimator has no .anomaly
+        resp = await client.post(
+            "/gordo/v0/proj/machine-b/anomaly/prediction", json=_x_payload()
+        )
+        assert resp.status == 422
+
+
+async def test_download_model(artifact_dir):
+    async with make_client(artifact_dir) as client:
+        resp = await client.get("/gordo/v0/proj/machine-b/download-model")
+        assert resp.status == 200
+        model = serializer.loads(await resp.read())
+        assert isinstance(model, AutoEncoder)
+
+
+def test_frame_dict_roundtrip():
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {("a", "x"): [1.0, 2.0], ("a", "y"): [3.0, 4.0], ("b", ""): [5.0, 6.0]},
+        index=pd.date_range("2020", periods=2, freq="1h", tz="UTC"),
+    )
+    df.columns = pd.MultiIndex.from_tuples(df.columns)
+    rt = dict_to_frame(frame_to_dict(df))
+    assert list(rt.columns) == list(df.columns)
+    np.testing.assert_allclose(rt.values, df.values)
