@@ -182,11 +182,11 @@ def provide_saved_model(
     )
     build_metadata["model"]["model_builder_cache_key"] = cache_key
 
-    dest = (
-        os.path.join(model_register_dir, cache_key)
-        if model_register_dir
-        else output_dir
-    )
+    # Only TRAINED models enter the build-cache registry: a cross_val_only
+    # run must not register an unfitted artifact under the same key a full
+    # build would hit.
+    register = model_register_dir and build_metadata["model"]["trained"]
+    dest = os.path.join(model_register_dir, cache_key) if register else output_dir
     serializer.dump(model, dest, metadata=build_metadata)
     _mirror_artifact(dest, output_dir)
     logger.info("Model %s built and saved to %s", name, dest)
@@ -198,9 +198,8 @@ def _mirror_artifact(src_dir: str, output_dir: str) -> None:
     location — reruns must still populate the serving volume."""
     if os.path.abspath(src_dir) == os.path.abspath(output_dir):
         return
+    import shutil
+
     os.makedirs(output_dir, exist_ok=True)
     for fname in os.listdir(src_dir):
-        src = os.path.join(src_dir, fname)
-        dst = os.path.join(output_dir, fname)
-        with open(src, "rb") as fs, open(dst, "wb") as fd:
-            fd.write(fs.read())
+        shutil.copy2(os.path.join(src_dir, fname), os.path.join(output_dir, fname))

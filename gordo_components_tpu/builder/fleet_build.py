@@ -45,6 +45,25 @@ _SCALER_PATHS = (
     "gordo_components_tpu.models.transformers.JaxMinMaxScaler",
 )
 
+# AutoEncoder kwargs the fleet path honors with semantics identical to the
+# single-build path: FleetTrainer's own training knobs plus the feedforward
+# factory surface. Anything else (e.g. validation_split, loss overrides)
+# must take the single-build path rather than be silently dropped.
+_TRAINER_KEYS = frozenset(
+    {
+        "kind", "epochs", "batch_size", "learning_rate", "optimizer",
+        "early_stopping_patience", "early_stopping_min_delta", "seed",
+        "compute_dtype",
+    }
+)
+_FACTORY_KEYS = frozenset(
+    {
+        "encoding_dim", "decoding_dim", "encoding_func", "decoding_func",
+        "out_func", "dims", "funcs", "encoding_layers", "compression_factor",
+        "func",
+    }
+)
+
 
 def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """If ``model_config`` is EXACTLY the canonical anomaly pipeline —
@@ -78,15 +97,22 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             s = s[1]
         inner.append(s)
     if len(inner) == 2 and _is_path(inner[0], _SCALER_PATHS):
-        return _ae_kwargs(inner[1])
+        ae = _ae_kwargs(inner[1])
+        if ae is not None and set(ae) - (_TRAINER_KEYS | _FACTORY_KEYS):
+            return None  # kwargs the trainer can't honor identically
+        return ae
     return None
 
 
 def _is_path(defn, paths) -> bool:
+    """True iff ``defn`` names one of ``paths`` with NO constructor kwargs —
+    a scaler with e.g. a custom feature_range must not take the fleet path
+    (which always fits the default (0, 1) min-max)."""
     if isinstance(defn, str):
         return defn in paths
     if isinstance(defn, dict) and len(defn) == 1:
-        return next(iter(defn)) in paths
+        (path, kwargs), = defn.items()
+        return path in paths and not kwargs
     return False
 
 

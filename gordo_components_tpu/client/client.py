@@ -80,16 +80,23 @@ class Client:
         ds_meta = meta.get("dataset", {})
         config = self.metadata_fallback_dataset or {"type": "RandomDataset"}
         if ds_meta:
+            # Tag dicts ({name, asset}) pass through whole: dropping asset
+            # would break providers with asset-scoped layouts; row_filter and
+            # aggregation must match training or scored rows diverge from
+            # what the model saw.
             config = {
                 "type": ds_meta.get("type", "TimeSeriesDataset"),
-                "tag_list": [t["name"] for t in ds_meta.get("tag_list", [])],
+                "tag_list": ds_meta.get("tag_list", []),
                 "resolution": ds_meta.get("resolution", "10min"),
+                "aggregation_method": ds_meta.get("aggregation_method", "mean"),
+                "row_filter": ds_meta.get("row_filter", ""),
                 "data_provider": ds_meta.get("data_provider"),
             }
-            if isinstance(config["data_provider"], dict):
-                # provider dict re-instantiated by the dataset layer
-                pass
-            else:
+            if ds_meta.get("target_tag_list"):
+                config["target_tag_list"] = ds_meta["target_tag_list"]
+            if not isinstance(config["data_provider"], dict):
+                # only a provider dict can be re-instantiated by the
+                # dataset layer; a repr string cannot
                 config.pop("data_provider", None)
         return {
             **config,

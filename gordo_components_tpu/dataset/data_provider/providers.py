@@ -123,6 +123,17 @@ class FileSystemProvider(GordoBaseDataProvider):
             yield series
 
 
+def _iql_ident(name: str) -> str:
+    """Quote an InfluxQL identifier (measurement/field): ``"`` doubles."""
+    return '"' + str(name).replace('"', '""') + '"'
+
+
+def _iql_str(value: str) -> str:
+    """Quote an InfluxQL string literal: backslash-escape ``\\`` and ``'``
+    so config-supplied tag names can't break or extend the query."""
+    return "'" + str(value).replace("\\", "\\\\").replace("'", "\\'") + "'"
+
+
 class InfluxDataProvider(GordoBaseDataProvider):
     """Per-tag InfluxDB measurement queries (reference:
     ``InfluxDataProvider`` + ``influx_client_from_uri``)."""
@@ -171,8 +182,9 @@ class InfluxDataProvider(GordoBaseDataProvider):
     ) -> Iterable[pd.Series]:
         for tag in tag_list:
             q = (
-                f'SELECT "{self.value_name}" FROM "{self.measurement}" '
-                f"WHERE (\"tag\" = '{tag.name}') "
+                f'SELECT {_iql_ident(self.value_name)} '
+                f'FROM {_iql_ident(self.measurement)} '
+                f'WHERE ("tag" = {_iql_str(tag.name)}) '
                 f"AND time >= '{from_ts.isoformat()}' AND time < '{to_ts.isoformat()}'"
             )
             logger.debug("influx query: %s", q)

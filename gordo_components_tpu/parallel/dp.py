@@ -22,10 +22,13 @@ from gordo_components_tpu.ops.losses import mse_loss
 DATA_AXIS = "data"
 
 
-def data_mesh(n_devices=None) -> Mesh:
+def data_mesh(n_devices=None, devices=None) -> Mesh:
     import numpy as np
 
-    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 

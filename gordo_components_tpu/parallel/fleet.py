@@ -119,7 +119,7 @@ class FleetTrainer:
         self,
         kind: str = "feedforward_hourglass",
         epochs: int = 10,
-        batch_size: int = 64,
+        batch_size: int = 100,  # matches BaseEstimator's default
         learning_rate: float = 1e-3,
         optimizer: str = "adam",
         early_stopping_patience: Optional[int] = None,
@@ -264,6 +264,20 @@ class FleetTrainer:
             dtype=np.int64,
         )
         histories: List[List[float]] = [[] for _ in range(M)]
+
+        # best-params restore, matching BaseEstimator.fit: each member ends
+        # on the params of its best epoch, not the epoch it stopped at
+        best_params = None
+        if self.early_stopping_patience:
+
+            @jax.jit
+            def merge_best(best_p, new_p, improved):
+                def sel(b, n):
+                    shape = (-1,) + (1,) * (n.ndim - 1)
+                    return jnp.where(improved.reshape(shape) > 0, n, b)
+
+                return jax.tree.map(sel, best_p, new_p)
+
         for epoch in range(self.epochs):
             states, losses = run_epoch(states, Xd, maskd, jnp.asarray(active))
             losses = np.asarray(losses)
@@ -271,8 +285,16 @@ class FleetTrainer:
                 if active[i] > 0:
                     histories[i].append(float(losses[i]))
             if self.early_stopping_patience:
-                improved = losses < best - self.early_stopping_min_delta
-                best = np.where(improved & (active > 0), losses, best)
+                improved = (losses < best - self.early_stopping_min_delta) & (
+                    active > 0
+                )
+                best = np.where(improved, losses, best)
+                if best_params is None:
+                    best_params = jax.tree.map(jnp.copy, states.params)
+                else:
+                    best_params = merge_best(
+                        best_params, states.params, jnp.asarray(improved, jnp.float32)
+                    )
                 patience = np.where(
                     improved, self.early_stopping_patience, patience - (active > 0)
                 )
@@ -280,6 +302,8 @@ class FleetTrainer:
                 if not active.any():
                     logger.info("All %d models early-stopped at epoch %d", M, epoch + 1)
                     break
+
+        final_params = best_params if best_params is not None else states.params
 
         # ---- error scalers + thresholds for the anomaly contract: one
         # vmapped pass (parity with DiffBasedAnomalyDetector.fit, which
@@ -300,13 +324,13 @@ class FleetTrainer:
             return jax.vmap(one)(params, X, mask)
 
         err_scalers, feat_thresh, total_thresh = fit_error_scalers(
-            states.params, Xd, maskd
+            final_params, Xd, maskd
         )
         feat_thresh = np.asarray(feat_thresh)
         total_thresh = np.asarray(total_thresh)
 
         # ---- unstack to host ----
-        params_np = jax.tree.map(np.asarray, states.params)
+        params_np = jax.tree.map(np.asarray, final_params)
         scalers_np = jax.tree.map(np.asarray, scalers)
         err_np = jax.tree.map(np.asarray, err_scalers)
 

@@ -120,6 +120,25 @@ class TestProvideSavedModel:
         p2 = provide_saved_model("machine-1", **kwargs)
         assert p1 == p2
 
+    def test_cross_val_only_does_not_poison_cache(self, tmp_path):
+        """An untrained (cross_val_only) artifact must not enter the build
+        cache where a later full build would hit it."""
+        kwargs = dict(
+            model_config=MODEL_CONFIG,
+            data_config=DATA_CONFIG,
+            output_dir=str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        provide_saved_model(
+            "machine-1",
+            evaluation_config={"cv_mode": "cross_val_only", "n_splits": 2},
+            **kwargs,
+        )
+        p2 = provide_saved_model("machine-1", **kwargs)
+        md = serializer.load_metadata(p2)
+        assert md["model"]["trained"]
+        assert serializer.load(p2).predict is not None
+
     def test_replace_cache_rebuilds(self, tmp_path):
         kwargs = dict(
             model_config=MODEL_CONFIG,

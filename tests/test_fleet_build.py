@@ -85,6 +85,45 @@ class TestExtractFleetable:
         }
         assert extract_fleetable(cfg) is None
 
+    def test_scaler_kwargs_not_fleetable(self):
+        """A scaler with non-default kwargs (custom feature_range) must not
+        take the fleet path, which always fits the default (0, 1) min-max."""
+        cfg = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            {
+                                "sklearn.preprocessing.MinMaxScaler": {
+                                    "feature_range": [-1, 1]
+                                }
+                            },
+                            "gordo_components_tpu.models.AutoEncoder",
+                        ]
+                    }
+                }
+            }
+        }
+        assert extract_fleetable(cfg) is None
+
+    def test_unsupported_ae_kwargs_not_fleetable(self):
+        """AE kwargs the trainer can't honor (validation_split, loss) must
+        force the single-build path instead of being silently dropped."""
+        for bad in ({"validation_split": 0.2}, {"loss": "mse"}):
+            cfg = {
+                "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        "sklearn.pipeline.Pipeline": {
+                            "steps": [
+                                "sklearn.preprocessing.MinMaxScaler",
+                                {"gordo_components_tpu.models.AutoEncoder": bad},
+                            ]
+                        }
+                    }
+                }
+            }
+            assert extract_fleetable(cfg) is None
+
     def test_unscaled_pipeline_not_fleetable(self):
         """A pipeline without a scaler step must not be silently min-max
         scaled by the fleet engine."""
