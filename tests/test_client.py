@@ -1,0 +1,141 @@
+"""Bulk-client tests against an in-process model server (reference strategy:
+mock/in-process HTTP rather than real deployments, SURVEY.md §4). The server
+runs on a real localhost port because ``Client`` owns its own session."""
+
+import contextlib
+
+import numpy as np
+import pandas as pd
+import pytest
+from aiohttp.test_utils import TestServer
+
+from gordo_components_tpu.builder import provide_saved_model
+from gordo_components_tpu.client import (
+    Client,
+    ForwardPredictionsIntoInflux,
+    ForwardPredictionsIntoParquet,
+    PredictionResult,
+)
+from gordo_components_tpu.server import build_app
+
+MODEL_CONFIG = {
+    "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    "gordo_components_tpu.models.transformers.JaxMinMaxScaler",
+                    {
+                        "gordo_components_tpu.models.AutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "epochs": 2,
+                            "batch_size": 64,
+                        }
+                    },
+                ]
+            }
+        }
+    }
+}
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2017-12-25 06:00:00Z",
+    "train_end_date": "2017-12-26 06:00:00Z",
+    "tag_list": ["tag-0", "tag-1", "tag-2"],
+}
+
+
+@pytest.fixture(scope="module")
+def collection_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("client-collection")
+    provide_saved_model(
+        "machine-a", MODEL_CONFIG, DATA_CONFIG, output_dir=str(root / "machine-a")
+    )
+    return str(root)
+
+
+@contextlib.asynccontextmanager
+async def live_server(collection_dir):
+    server = TestServer(build_app(collection_dir))
+    await server.start_server()
+    try:
+        yield f"http://{server.host}:{server.port}"
+    finally:
+        await server.close()
+
+
+async def test_client_predict_end_to_end(collection_dir):
+    async with live_server(collection_dir) as base_url:
+        client = Client("proj", base_url=base_url, batch_size=10, parallelism=4)
+        results = await client.predict_async(
+            pd.Timestamp("2017-12-25 06:00:00Z"),
+            pd.Timestamp("2017-12-25 12:00:00Z"),
+        )
+    assert len(results) == 1
+    res = results[0]
+    assert res.name == "machine-a"
+    assert res.ok, res.error_messages
+    # anomaly frames carry the multi-level anomaly contract columns
+    assert ("total-anomaly-scaled", "") in res.predictions.columns
+    # chunking (batch_size=10 over a 36-row range) must reassemble every
+    # scored row exactly once
+    assert res.predictions.index.is_unique
+    assert len(res.predictions) > 10
+
+
+async def test_client_unknown_target_reports_error(collection_dir):
+    async with live_server(collection_dir) as base_url:
+        client = Client("proj", base_url=base_url)
+        results = await client.predict_async(
+            pd.Timestamp("2017-12-25 06:00:00Z"),
+            pd.Timestamp("2017-12-25 08:00:00Z"),
+            targets=["ghost"],
+        )
+    assert len(results) == 1
+    assert not results[0].ok
+    assert results[0].error_messages
+
+
+async def test_client_plain_prediction_endpoint(collection_dir):
+    async with live_server(collection_dir) as base_url:
+        client = Client("proj", base_url=base_url, use_anomaly=False)
+        results = await client.predict_async(
+            pd.Timestamp("2017-12-25 06:00:00Z"),
+            pd.Timestamp("2017-12-25 08:00:00Z"),
+        )
+    assert results[0].ok, results[0].error_messages
+    assert len(results[0].predictions) > 0
+
+
+def _result_frame():
+    idx = pd.date_range("2020-01-01", periods=3, freq="10min", tz="UTC")
+    df = pd.DataFrame({("total-anomaly", ""): [1.0, 2.0, 3.0]}, index=idx)
+    df.columns = pd.MultiIndex.from_tuples(df.columns)
+    return PredictionResult("machine-a", df)
+
+
+def test_parquet_forwarder(tmp_path):
+    fwd = ForwardPredictionsIntoParquet(str(tmp_path / "store"))
+    fwd.forward(_result_frame())
+    out = pd.read_parquet(tmp_path / "store" / "machine-a.parquet")
+    np.testing.assert_allclose(out["total-anomaly"].values, [1.0, 2.0, 3.0])
+
+
+def test_influx_forwarder_requires_client():
+    with pytest.raises(ValueError):
+        ForwardPredictionsIntoInflux()
+
+
+def test_influx_forwarder_points():
+    class FakeInflux:
+        def __init__(self):
+            self.points = []
+
+        def write_points(self, points):
+            self.points.extend(points)
+
+    fake = FakeInflux()
+    ForwardPredictionsIntoInflux(client=fake).forward(_result_frame())
+    assert len(fake.points) == 3
+    p = fake.points[0]
+    assert p["tags"] == {"machine": "machine-a", "field": "total-anomaly"}
+    assert p["fields"] == {"value": 1.0}

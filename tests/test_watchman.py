@@ -1,0 +1,86 @@
+"""Watchman tests: aggregate fleet health over an in-process model server
+(reference strategy: mocked HTTP, SURVEY.md §4)."""
+
+import contextlib
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.models import AutoEncoder
+from gordo_components_tpu.server import build_app
+from gordo_components_tpu.watchman.server import WatchmanState, build_watchman_app
+
+
+@pytest.fixture(scope="module")
+def collection_dir(tmp_path_factory):
+    rng = np.random.RandomState(0)
+    X = rng.rand(100, 3).astype("float32")
+    root = tmp_path_factory.mktemp("watchman-collection")
+    for name in ("m-1", "m-2"):
+        model = AutoEncoder(epochs=1, batch_size=64)
+        model.fit(X)
+        serializer.dump(model, str(root / name), metadata={"name": name})
+    return str(root)
+
+
+@contextlib.asynccontextmanager
+async def live_model_server(collection_dir):
+    server = TestServer(build_app(collection_dir))
+    await server.start_server()
+    try:
+        yield f"http://{server.host}:{server.port}"
+    finally:
+        await server.close()
+
+
+async def test_watchman_aggregates_health_and_metadata(collection_dir):
+    async with live_model_server(collection_dir) as base_url:
+        app = build_watchman_app("proj", base_url)  # discovers targets
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/")
+            assert resp.status == 200
+            body = await resp.json()
+        finally:
+            await client.close()
+    assert body["project_name"] == "proj"
+    by_target = {e["target"]: e for e in body["endpoints"]}
+    assert set(by_target) == {"m-1", "m-2"}
+    for name, entry in by_target.items():
+        assert entry["healthy"] is True
+        assert entry["endpoint-metadata"]["name"] == name
+        assert entry["endpoint"] == f"/gordo/v0/proj/{name}/"
+
+
+async def test_watchman_marks_unreachable_unhealthy():
+    # nothing listens on this port; explicit target list skips discovery
+    state = WatchmanState(
+        "proj", "http://127.0.0.1:1", targets=["m-1"], refresh_interval=30
+    )
+    snap = await state.snapshot()
+    assert snap["endpoints"][0]["healthy"] is False
+    assert "endpoint-metadata" not in snap["endpoints"][0]
+
+
+async def test_watchman_caches_snapshot(collection_dir):
+    async with live_model_server(collection_dir) as base_url:
+        state = WatchmanState("proj", base_url, refresh_interval=300)
+        first = await state.snapshot()
+    # server is gone, but the cache answers within refresh_interval
+    second = await state.snapshot()
+    assert second is first
+
+
+async def test_watchman_healthcheck_endpoint():
+    app = build_watchman_app("proj", "http://127.0.0.1:1", targets=[])
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.get("/healthcheck")
+        assert resp.status == 200
+        assert "gordo-watchman-version" in await resp.json()
+    finally:
+        await client.close()
