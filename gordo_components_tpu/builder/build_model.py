@@ -42,7 +42,7 @@ def build_model(
     explained-variance scores recorded into metadata (reference behavior).
     """
     metadata = dict(metadata or {})
-    evaluation_config = {"cv_mode": "full_build", **(evaluation_config or {})}
+    evaluation_config = _normalize_evaluation(evaluation_config)
 
     t0 = time.time()
     dataset = get_dataset(dict(data_config))
@@ -52,12 +52,10 @@ def build_model(
     model = serializer.from_definition(model_config)
 
     cv_meta: Dict[str, Any] = {}
-    n_splits = int(evaluation_config.get("n_splits", 3))
-    wants_cv = evaluation_config["cv_mode"] == "cross_val_only" or evaluation_config.get(
-        "cross_validation", False
-    )
-    if wants_cv and n_splits > 0:
-        cv_meta = _cross_validate(model_config, X, y, n_splits)
+    if _wants_cv(evaluation_config):
+        cv_meta = _cross_validate(
+            model_config, X, y, int(evaluation_config.get("n_splits", 3))
+        )
 
     t1 = time.time()
     trained = False
@@ -83,6 +81,17 @@ def build_model(
     if cv_meta:
         build_metadata["model"]["cross-validation"] = cv_meta
     return model, build_metadata
+
+
+def _normalize_evaluation(evaluation_config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    return {"cv_mode": "full_build", **(evaluation_config or {})}
+
+
+def _wants_cv(evaluation_config: Dict[str, Any]) -> bool:
+    wants = evaluation_config["cv_mode"] == "cross_val_only" or bool(
+        evaluation_config.get("cross_validation", False)
+    )
+    return wants and int(evaluation_config.get("n_splits", 3)) > 0
 
 
 def _pipeline_metadata(model) -> Dict[str, Any]:
@@ -170,12 +179,33 @@ def provide_saved_model(
     artifact directory path (reference semantics)."""
     cache_key = calculate_model_key(name, model_config, data_config, metadata)
 
-    if model_register_dir and not replace_cache:
+    # The cache key excludes evaluation_config, so a cached artifact only
+    # satisfies a CV-requesting run if it already carries CV metadata; a
+    # cross_val_only run never takes the cache (its contract is an untrained
+    # evaluation artifact, not a trained one).
+    evaluation = _normalize_evaluation(evaluation_config)
+    cross_val_only = evaluation["cv_mode"] == "cross_val_only"
+
+    if model_register_dir and not replace_cache and not cross_val_only:
         cached = os.path.join(model_register_dir, cache_key)
         if os.path.isdir(cached) and os.path.exists(os.path.join(cached, "model.pkl")):
-            logger.info("Model %s found in build cache: %s", name, cached)
-            _mirror_artifact(cached, output_dir)
-            return cached
+            if _wants_cv(evaluation):
+                # the cached CV must match the requested fold count, or the
+                # hit would report stats for a CV the caller didn't ask for
+                folds = (
+                    serializer.load_metadata(cached)
+                    .get("model", {})
+                    .get("cross-validation", {})
+                    .get("explained-variance", {})
+                    .get("per-fold", [])
+                )
+                cv_satisfied = len(folds) == int(evaluation.get("n_splits", 3))
+            else:
+                cv_satisfied = True
+            if cv_satisfied:
+                logger.info("Model %s found in build cache: %s", name, cached)
+                _mirror_artifact(cached, output_dir)
+                return cached
 
     model, build_metadata = build_model(
         name, model_config, data_config, metadata, evaluation_config

@@ -206,13 +206,7 @@ def _build_fleet_group(
     load_elapsed = time.time() - t0
 
     trainer_kwargs = {
-        k: ae_kwargs.pop(k)
-        for k in (
-            "epochs", "batch_size", "learning_rate", "optimizer", "kind",
-            "early_stopping_patience", "early_stopping_min_delta", "seed",
-            "compute_dtype",
-        )
-        if k in ae_kwargs
+        k: ae_kwargs.pop(k) for k in _TRAINER_KEYS if k in ae_kwargs
     }
     trainer = FleetTrainer(**trainer_kwargs, **ae_kwargs)
     t1 = time.time()

@@ -124,8 +124,10 @@ class FileSystemProvider(GordoBaseDataProvider):
 
 
 def _iql_ident(name: str) -> str:
-    """Quote an InfluxQL identifier (measurement/field): ``"`` doubles."""
-    return '"' + str(name).replace('"', '""') + '"'
+    """Quote an InfluxQL identifier (measurement/field): backslash-escape
+    ``\\`` and ``"`` (InfluxQL uses ``\\"`` inside quoted identifiers, not
+    SQL-style doubling)."""
+    return '"' + str(name).replace("\\", "\\\\").replace('"', '\\"') + '"'
 
 
 def _iql_str(value: str) -> str:

@@ -258,9 +258,10 @@ class FleetTrainer:
         # and drives per-model early stopping ----
         active = np.ones((M,), dtype=np.float32)
         best = np.full((M,), np.inf)
+        es_enabled = self.early_stopping_patience is not None
         patience = np.full(
             (M,),
-            self.early_stopping_patience if self.early_stopping_patience else -1,
+            self.early_stopping_patience if es_enabled else -1,
             dtype=np.int64,
         )
         histories: List[List[float]] = [[] for _ in range(M)]
@@ -268,7 +269,7 @@ class FleetTrainer:
         # best-params restore, matching BaseEstimator.fit: each member ends
         # on the params of its best epoch, not the epoch it stopped at
         best_params = None
-        if self.early_stopping_patience:
+        if es_enabled:
 
             @jax.jit
             def merge_best(best_p, new_p, improved):
@@ -284,7 +285,7 @@ class FleetTrainer:
             for i in range(M):
                 if active[i] > 0:
                     histories[i].append(float(losses[i]))
-            if self.early_stopping_patience:
+            if es_enabled:
                 improved = (losses < best - self.early_stopping_min_delta) & (
                     active > 0
                 )
@@ -298,7 +299,12 @@ class FleetTrainer:
                 patience = np.where(
                     improved, self.early_stopping_patience, patience - (active > 0)
                 )
-                active = np.where(patience <= 0, 0.0, active).astype(np.float32)
+                # patience=0 parity with BaseEstimator.fit: a model stops only
+                # after a NON-improving epoch exhausts patience — an epoch
+                # that just improved (and reset patience to 0) keeps going.
+                active = np.where(
+                    (patience <= 0) & ~improved, 0.0, active
+                ).astype(np.float32)
                 if not active.any():
                     logger.info("All %d models early-stopped at epoch %d", M, epoch + 1)
                     break

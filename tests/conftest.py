@@ -23,6 +23,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import asyncio
+import contextlib
 import inspect
 
 import numpy as np
@@ -41,6 +42,26 @@ def pytest_pyfunc_call(pyfuncitem):
         asyncio.run(pyfuncitem.function(**kwargs))
         return True
     return None
+
+
+@pytest.fixture(scope="session")
+def live_server():
+    """Factory: async context manager serving a model collection dir on a
+    real localhost port (for clients that own their own HTTP session)."""
+    from aiohttp.test_utils import TestServer
+
+    from gordo_components_tpu.server import build_app
+
+    @contextlib.asynccontextmanager
+    async def _live(model_dir: str):
+        server = TestServer(build_app(model_dir))
+        await server.start_server()
+        try:
+            yield f"http://{server.host}:{server.port}"
+        finally:
+            await server.close()
+
+    return _live
 
 
 @pytest.fixture(scope="session")

@@ -150,3 +150,24 @@ class TestProvideSavedModel:
         mtime = os.path.getmtime(os.path.join(p1, "model.pkl"))
         p2 = provide_saved_model("machine-1", replace_cache=True, **kwargs)
         assert os.path.getmtime(os.path.join(p2, "model.pkl")) >= mtime
+
+    def test_warm_cache_does_not_skip_requested_cv(self, tmp_path):
+        """A cross_val_only run against a warm registry must still run CV
+        (the cache key excludes evaluation_config)."""
+        kwargs = dict(
+            model_config=MODEL_CONFIG,
+            data_config=DATA_CONFIG,
+            output_dir=str(tmp_path / "out"),
+            model_register_dir=str(tmp_path / "reg"),
+        )
+        provide_saved_model("machine-1", **kwargs)  # warm the registry
+        out2 = str(tmp_path / "out2")
+        kwargs["output_dir"] = out2
+        provide_saved_model(
+            "machine-1",
+            evaluation_config={"cv_mode": "cross_val_only", "n_splits": 2},
+            **kwargs,
+        )
+        md = serializer.load_metadata(out2)
+        assert "cross-validation" in md["model"]
+        assert not md["model"]["trained"]

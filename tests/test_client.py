@@ -2,12 +2,9 @@
 mock/in-process HTTP rather than real deployments, SURVEY.md §4). The server
 runs on a real localhost port because ``Client`` owns its own session."""
 
-import contextlib
-
 import numpy as np
 import pandas as pd
 import pytest
-from aiohttp.test_utils import TestServer
 
 from gordo_components_tpu.builder import provide_saved_model
 from gordo_components_tpu.client import (
@@ -16,7 +13,6 @@ from gordo_components_tpu.client import (
     ForwardPredictionsIntoParquet,
     PredictionResult,
 )
-from gordo_components_tpu.server import build_app
 
 MODEL_CONFIG = {
     "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
@@ -53,17 +49,8 @@ def collection_dir(tmp_path_factory):
     return str(root)
 
 
-@contextlib.asynccontextmanager
-async def live_server(collection_dir):
-    server = TestServer(build_app(collection_dir))
-    await server.start_server()
-    try:
-        yield f"http://{server.host}:{server.port}"
-    finally:
-        await server.close()
 
-
-async def test_client_predict_end_to_end(collection_dir):
+async def test_client_predict_end_to_end(collection_dir, live_server):
     async with live_server(collection_dir) as base_url:
         client = Client("proj", base_url=base_url, batch_size=10, parallelism=4)
         results = await client.predict_async(
@@ -82,7 +69,7 @@ async def test_client_predict_end_to_end(collection_dir):
     assert len(res.predictions) > 10
 
 
-async def test_client_unknown_target_reports_error(collection_dir):
+async def test_client_unknown_target_reports_error(collection_dir, live_server):
     async with live_server(collection_dir) as base_url:
         client = Client("proj", base_url=base_url)
         results = await client.predict_async(
@@ -95,7 +82,7 @@ async def test_client_unknown_target_reports_error(collection_dir):
     assert results[0].error_messages
 
 
-async def test_client_plain_prediction_endpoint(collection_dir):
+async def test_client_plain_prediction_endpoint(collection_dir, live_server):
     async with live_server(collection_dir) as base_url:
         client = Client("proj", base_url=base_url, use_anomaly=False)
         results = await client.predict_async(

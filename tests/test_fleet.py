@@ -137,3 +137,23 @@ class TestFleetTrainer:
         x = np.zeros((16, 4), dtype=np.float32)
         sharded = jax.device_put(x, shard_model_axis(mesh))
         assert len(sharded.sharding.device_set) == 8
+
+    def test_early_stopping_patience_zero_matches_single_path(self):
+        """patience=0 means 'stop after the first non-improving epoch' on
+        BOTH build paths — not 'disabled' (fleet) vs 'enabled' (single)."""
+        members = _member_data(2)
+        # min_delta so large no epoch counts as improving after the first:
+        # patience=0 must stop at epoch 2, not run all 40 (fleet previously
+        # treated 0 as "disabled") and not stop at epoch 1 (improving epochs
+        # never decrement patience).
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric",
+            dims=(8,),
+            epochs=40,
+            batch_size=64,
+            early_stopping_patience=0,
+            early_stopping_min_delta=10.0,
+        )
+        models = trainer.fit(members)
+        for m in models.values():
+            assert len(m.history["loss"]) == 2

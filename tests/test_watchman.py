@@ -1,8 +1,6 @@
 """Watchman tests: aggregate fleet health over an in-process model server
 (reference strategy: mocked HTTP, SURVEY.md §4)."""
 
-import contextlib
-
 import numpy as np
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
@@ -25,18 +23,9 @@ def collection_dir(tmp_path_factory):
     return str(root)
 
 
-@contextlib.asynccontextmanager
-async def live_model_server(collection_dir):
-    server = TestServer(build_app(collection_dir))
-    await server.start_server()
-    try:
-        yield f"http://{server.host}:{server.port}"
-    finally:
-        await server.close()
 
-
-async def test_watchman_aggregates_health_and_metadata(collection_dir):
-    async with live_model_server(collection_dir) as base_url:
+async def test_watchman_aggregates_health_and_metadata(collection_dir, live_server):
+    async with live_server(collection_dir) as base_url:
         app = build_watchman_app("proj", base_url)  # discovers targets
         client = TestClient(TestServer(app))
         await client.start_server()
@@ -65,8 +54,8 @@ async def test_watchman_marks_unreachable_unhealthy():
     assert "endpoint-metadata" not in snap["endpoints"][0]
 
 
-async def test_watchman_caches_snapshot(collection_dir):
-    async with live_model_server(collection_dir) as base_url:
+async def test_watchman_caches_snapshot(collection_dir, live_server):
+    async with live_server(collection_dir) as base_url:
         state = WatchmanState("proj", base_url, refresh_interval=300)
         first = await state.snapshot()
     # server is gone, but the cache answers within refresh_interval
