@@ -44,6 +44,33 @@ def _score_fn(err_scale: ScalerParams, target: jnp.ndarray, output: jnp.ndarray)
     return diff, scaled, total_unscaled, total_scaled
 
 
+def assemble_anomaly_frame(
+    tags, inp, output, diff, scaled, tot_u, tot_s, index=None
+) -> pd.DataFrame:
+    """Assemble the reference's multi-level anomaly frame from score arrays.
+
+    Shared by :meth:`DiffBasedAnomalyDetector.anomaly` and the server's
+    HBM-resident model bank (server/bank.py) so the two scoring paths are
+    frame-identical by construction.
+    """
+    inp = np.asarray(inp)
+    frames = {("model-input", t): inp[:, i] for i, t in enumerate(tags)}
+    frames.update(
+        {("model-output", t): np.asarray(output)[:, i] for i, t in enumerate(tags)}
+    )
+    frames.update(
+        {("tag-anomaly-unscaled", t): np.asarray(diff)[:, i] for i, t in enumerate(tags)}
+    )
+    frames.update(
+        {("tag-anomaly-scaled", t): np.asarray(scaled)[:, i] for i, t in enumerate(tags)}
+    )
+    df = pd.DataFrame(frames, index=index)
+    df[("total-anomaly-unscaled", "")] = np.asarray(tot_u)
+    df[("total-anomaly-scaled", "")] = np.asarray(tot_s)
+    df.columns = pd.MultiIndex.from_tuples(df.columns)
+    return df
+
+
 class DiffBasedAnomalyDetector(AnomalyDetectorBase):
     """Anomaly = norm of (per-feature scaled) |y - reconstruction|."""
 
@@ -164,18 +191,9 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         diff, scaled, tot_u, tot_s = _score_fn(
             ScalerParams(*self.error_scaler_), jnp.asarray(target), jnp.asarray(output)
         )
-
-        frames = {
-            ("model-input", t): inp[:, i] for i, t in enumerate(tags)
-        }
-        frames.update({("model-output", t): np.asarray(output)[:, i] for i, t in enumerate(tags)})
-        frames.update({("tag-anomaly-unscaled", t): np.asarray(diff)[:, i] for i, t in enumerate(tags)})
-        frames.update({("tag-anomaly-scaled", t): np.asarray(scaled)[:, i] for i, t in enumerate(tags)})
-        df = pd.DataFrame(frames, index=index)
-        df[("total-anomaly-unscaled", "")] = np.asarray(tot_u)
-        df[("total-anomaly-scaled", "")] = np.asarray(tot_s)
-        df.columns = pd.MultiIndex.from_tuples(df.columns)
-        return df
+        return assemble_anomaly_frame(
+            tags, inp, output, diff, scaled, tot_u, tot_s, index
+        )
 
     def get_metadata(self) -> Dict[str, Any]:
         md: Dict[str, Any] = {

@@ -9,20 +9,56 @@ surface, so Ambassador-style routing by ``{target}`` still works.
 
 import asyncio
 import logging
+import os
 from typing import Optional
 
 from aiohttp import web
 
+from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 from gordo_components_tpu.server.model_io import ModelCollection
 from gordo_components_tpu.server.views import routes
 
 logger = logging.getLogger(__name__)
 
 
-def build_app(model_dir: str, target_name: Optional[str] = None) -> web.Application:
-    """App factory: loads the artifact(s) under ``model_dir`` once."""
+def build_app(
+    model_dir: str,
+    target_name: Optional[str] = None,
+    use_bank: Optional[bool] = None,
+    bank_flush_ms: float = 2.0,
+    bank_max_batch: int = 64,
+) -> web.Application:
+    """App factory: loads the artifact(s) under ``model_dir`` once.
+
+    When ``use_bank`` (default: env ``GORDO_SERVER_BANK`` != "0"), every
+    bankable model is additionally stacked into an HBM-resident
+    :class:`ModelBank` and requests are continuously batched through it;
+    non-bankable models keep the per-model scoring path.
+    """
+    if use_bank is None:
+        use_bank = os.environ.get("GORDO_SERVER_BANK", "1") != "0"
     app = web.Application(client_max_size=256 * 1024**2)
-    app["collection"] = ModelCollection(model_dir, target_name=target_name)
+    collection = ModelCollection(model_dir, target_name=target_name)
+    app["collection"] = collection
+    if use_bank:
+        bank = ModelBank.from_models(collection.models)
+        if len(bank):
+            app["bank"] = bank
+
+            async def _start_engine(app: web.Application) -> None:
+                engine = BatchingEngine(
+                    bank, max_batch=bank_max_batch, flush_ms=bank_flush_ms
+                )
+                engine.start()
+                app["bank_engine"] = engine
+
+            async def _stop_engine(app: web.Application) -> None:
+                engine = app.get("bank_engine")
+                if engine is not None:
+                    await engine.stop()
+
+            app.on_startup.append(_start_engine)
+            app.on_cleanup.append(_stop_engine)
     app.add_routes(routes)
     return app
 
@@ -42,4 +78,4 @@ def run_server(
     web.run_app(app, host=host, port=port)
 
 
-__all__ = ["build_app", "run_server", "ModelCollection"]
+__all__ = ["build_app", "run_server", "ModelCollection", "ModelBank", "BatchingEngine"]

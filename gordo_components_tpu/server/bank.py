@@ -1,0 +1,485 @@
+"""HBM-resident model bank: many models, one device, one compiled program.
+
+The reference serves one model per Flask process (gordo_components/server,
+unverified; SURVEY.md §2 "server") — scoring N machines means N processes
+each holding one Keras graph. The TPU-native inversion (BASELINE.json
+config 5, SURVEY.md §7 stage 5): every *bankable* model in the collection
+is stacked into one params pytree per (kind, n_features, architecture)
+bucket, resident in device HBM. A request for any model becomes an indexed
+gather into the stack inside a single jit'd scoring program, so
+
+- loading 1,000 models costs one ``device_put`` per bucket, not 1,000
+  processes;
+- concurrent requests for *different* models coalesce into one batched XLA
+  call (see :class:`BatchingEngine`) — the MXU sees (B, T, F) matmuls
+  instead of B separate (T, F) launches;
+- request shapes are bucketed to powers of two so the number of compiled
+  programs stays O(log(max_rows) * log(max_batch)) regardless of traffic.
+
+Bankable = DiffBasedAnomalyDetector over a feedforward AutoEncoder with at
+most one affine scaler in front (the reference's default pipeline shape).
+Sequence models (LSTM/conv windows) and bespoke pipelines fall back to the
+per-model scoring path in views.py — same response schema either way, via
+the shared ``assemble_anomaly_frame``.
+"""
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_components_tpu.models.anomaly.diff import (
+    DiffBasedAnomalyDetector,
+    assemble_anomaly_frame,
+)
+from gordo_components_tpu.models.register import lookup_factory
+from gordo_components_tpu.models.train_core import _next_pow2
+from gordo_components_tpu.ops.scaler import ScalerParams
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------- #
+# extraction: estimator object -> bankable pieces
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class _BankEntry:
+    name: str
+    kind: str
+    factory_kwargs: Dict[str, Any]
+    compute_dtype: str
+    n_features: int
+    params: Any  # numpy pytree
+    in_shift: np.ndarray
+    in_scale: np.ndarray
+    err_shift: np.ndarray
+    err_scale: np.ndarray
+
+
+def _affine_from_scaler(step, n_features: int):
+    """Return (shift, scale) arrays for a supported scaler step, or None.
+
+    Supports the JAX scalers (already affine) and sklearn's MinMaxScaler /
+    StandardScaler (converted: sklearn minmax ``x*scale_ + min_`` ==
+    ``(x - (-min_/scale_)) * scale_``).
+    """
+    params = getattr(step, "scaler_params_", None)
+    if params is not None:  # JaxMinMaxScaler / JaxStandardScaler
+        return np.asarray(params.shift), np.asarray(params.scale)
+    cls = type(step).__name__
+    if cls == "MinMaxScaler" and hasattr(step, "scale_"):
+        scale = np.asarray(step.scale_, np.float32)
+        return (-np.asarray(step.min_, np.float32) / scale), scale
+    if cls == "StandardScaler" and hasattr(step, "scale_"):
+        mean = getattr(step, "mean_", None)
+        shift = np.asarray(
+            mean if mean is not None else np.zeros(n_features), np.float32
+        )
+        return shift, 1.0 / np.asarray(step.scale_, np.float32)
+    return None
+
+
+def _extract_entry(name: str, model) -> Optional[_BankEntry]:
+    """Decompose a served model into bank pieces; None if not bankable."""
+    if not isinstance(model, DiffBasedAnomalyDetector):
+        return None
+    if model.error_scaler_ is None:
+        return None
+    base = model.base_estimator
+    pre_steps: Sequence = []
+    if hasattr(base, "steps"):
+        pre_steps, est = base.steps[:-1], base.steps[-1][1]
+    else:
+        est = base
+    # feedforward only: sequence estimators have a lookback warm-up offset
+    if type(est).__name__ != "AutoEncoder" or est.params_ is None:
+        return None
+    n_features = est.n_features_
+    # compose the (possibly chained) affine scalers into one:
+    # t(x) = (x - in_shift) * in_scale; appending ((t - s) * k) gives
+    # (x - (in_shift + s/in_scale)) * (in_scale * k)
+    in_shift = np.zeros((n_features,), np.float32)
+    in_scale = np.ones((n_features,), np.float32)
+    for _, step in pre_steps:
+        aff = _affine_from_scaler(step, n_features)
+        if aff is None:
+            return None  # non-affine preprocessing -> per-model path
+        s, k = np.asarray(aff[0], np.float32), np.asarray(aff[1], np.float32)
+        safe_scale = np.where(in_scale == 0, 1.0, in_scale)
+        in_shift = in_shift + s / safe_scale
+        in_scale = in_scale * k
+    err = ScalerParams(*model.error_scaler_)
+    return _BankEntry(
+        name=name,
+        kind=est.kind,
+        factory_kwargs=dict(est.factory_kwargs),
+        compute_dtype=getattr(est, "compute_dtype", "float32"),
+        n_features=int(n_features),
+        params=jax.tree.map(np.asarray, est.params_),
+        in_shift=in_shift.astype(np.float32),
+        in_scale=in_scale.astype(np.float32),
+        err_shift=np.asarray(err.shift, np.float32),
+        err_scale=np.asarray(err.scale, np.float32),
+    )
+
+
+# --------------------------------------------------------------------- #
+# bucket: stacked device state + compiled scoring program
+# --------------------------------------------------------------------- #
+
+
+def _prev_pow2(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+class _Bucket:
+    """All models sharing (kind, n_features, factory kwargs, dtype): one
+    stacked params pytree + scaler stacks in HBM, one scoring fn reused for
+    every (batch, rows) shape bucket."""
+
+    def __init__(
+        self,
+        kind: str,
+        n_features: int,
+        factory_kwargs: Dict[str, Any],
+        compute_dtype: str = "float32",
+    ):
+        self.kind = kind
+        self.n_features = n_features
+        self.factory_kwargs = factory_kwargs
+        self.compute_dtype = compute_dtype
+        self.names: List[str] = []
+        self._entries: List[_BankEntry] = []
+        # device state, built by finalize()
+        self.params = None
+        self.scalers = None  # (in_shift, in_scale, err_shift, err_scale)
+        self._score = None
+
+    def add(self, entry: _BankEntry) -> None:
+        self._entries.append(entry)
+        self.names.append(entry.name)
+
+    def finalize(self) -> None:
+        stacked = jax.tree.map(
+            lambda *leaves: np.stack(leaves), *[e.params for e in self._entries]
+        )
+        self.params = jax.device_put(stacked)
+        self.scalers = tuple(
+            jax.device_put(np.stack([getattr(e, f) for e in self._entries]))
+            for f in ("in_shift", "in_scale", "err_shift", "err_scale")
+        )
+        module = lookup_factory("AutoEncoder", self.kind)(
+            self.n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
+        )
+
+        def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
+            # idx: (B,) int32; X/Y: (B, T, F) raw-space
+            def one(i, x, y):
+                p = jax.tree.map(lambda a: a[i], params)
+                xs = (x - in_shift[i]) * in_scale[i]
+                ys = (y - in_shift[i]) * in_scale[i]
+                recon = module.apply(p, xs)
+                diff = jnp.abs(ys - recon)
+                scaled = (diff - err_shift[i]) * err_scale[i]
+                tot_u = jnp.linalg.norm(diff, axis=-1)
+                tot_s = jnp.linalg.norm(scaled, axis=-1)
+                return recon, diff, scaled, tot_u, tot_s
+
+            return jax.vmap(one)(idx, X, Y)
+
+        self._score = jax.jit(score)
+        self._entries = []  # host copies no longer needed
+
+    def score_batch(self, indices: np.ndarray, X: np.ndarray, Y: np.ndarray):
+        """indices: (B,), X/Y: (B, T, F) — already padded to pow2 B and T."""
+        return self._score(
+            self.params, *self.scalers, jnp.asarray(indices), jnp.asarray(X),
+            jnp.asarray(Y),
+        )
+
+
+# --------------------------------------------------------------------- #
+# the bank
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class ScoreResult:
+    """Raw-space arrays for one request, sliced back to its true length."""
+
+    tags: List[str]
+    model_input: np.ndarray
+    model_output: np.ndarray
+    diff: np.ndarray
+    scaled: np.ndarray
+    total_unscaled: np.ndarray
+    total_scaled: np.ndarray
+
+    def to_frame(self, index=None):
+        return assemble_anomaly_frame(
+            self.tags,
+            self.model_input,
+            self.model_output,
+            self.diff,
+            self.scaled,
+            self.total_unscaled,
+            self.total_scaled,
+            index,
+        )
+
+
+class ModelBank:
+    """Stacked scoring bank over a model collection (HBM-resident)."""
+
+    def __init__(self, max_rows_per_call: int = 8192):
+        self.max_rows = int(max_rows_per_call)
+        self._buckets: Dict[str, _Bucket] = {}
+        self._index: Dict[str, Tuple[str, int]] = {}  # name -> (bucket_key, i)
+        self._tags: Dict[str, List[str]] = {}
+
+    # -------------------------- construction -------------------------- #
+
+    @classmethod
+    def from_models(cls, models: Dict[str, Any], **kwargs) -> "ModelBank":
+        bank = cls(**kwargs)
+        for name, model in models.items():
+            entry = _extract_entry(name, model)
+            if entry is None:
+                logger.debug("Model %r is not bankable; per-model path", name)
+                continue
+            key = json.dumps(
+                [
+                    entry.kind,
+                    entry.n_features,
+                    entry.compute_dtype,
+                    sorted(entry.factory_kwargs.items()),
+                ],
+                default=str,
+            )
+            bucket = bank._buckets.get(key)
+            if bucket is None:
+                bucket = bank._buckets[key] = _Bucket(
+                    entry.kind,
+                    entry.n_features,
+                    entry.factory_kwargs,
+                    compute_dtype=entry.compute_dtype,
+                )
+            bank._index[name] = (key, len(bucket.names))
+            bucket.add(entry)
+            tags = getattr(models[name], "tags_", None)
+            bank._tags[name] = (
+                list(tags) if tags else [f"feature-{i}" for i in range(entry.n_features)]
+            )
+        for bucket in bank._buckets.values():
+            bucket.finalize()
+        if bank._index:
+            logger.info(
+                "Model bank: %d models in %d bucket(s)",
+                len(bank._index),
+                len(bank._buckets),
+            )
+        return bank
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._buckets)
+
+    # --------------------------- scoring ------------------------------ #
+
+    def score(self, name: str, X: np.ndarray, y: Optional[np.ndarray] = None) -> ScoreResult:
+        """Score one request (convenience wrapper over ``score_many``)."""
+        return self.score_many([(name, X, y)])[0]
+
+    def score_many(
+        self, requests: Sequence[Tuple[str, np.ndarray, Optional[np.ndarray]]]
+    ) -> List[ScoreResult]:
+        """Score a heterogeneous batch of (name, X, y) requests.
+
+        Requests are grouped by bucket, padded to pow2 (batch, rows) and
+        scored in one XLA call per group.
+        """
+        results: List[Optional[ScoreResult]] = [None] * len(requests)
+        by_bucket: Dict[str, List[int]] = {}
+        for ri, (name, X, _y) in enumerate(requests):
+            if name not in self._index:
+                raise KeyError(f"Model {name!r} not in bank")
+            by_bucket.setdefault(self._index[name][0], []).append(ri)
+
+        for key, req_ids in by_bucket.items():
+            bucket = self._buckets[key]
+            F = bucket.n_features
+            rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
+            for ri, X in zip(req_ids, rows):
+                if X.ndim != 2 or X.shape[1] != F:
+                    raise ValueError(
+                        f"Request for {requests[ri][0]!r}: expected (rows, {F}), "
+                        f"got {X.shape}"
+                    )
+                if X.shape[0] == 0:
+                    raise ValueError(f"Request for {requests[ri][0]!r}: empty input")
+            # rows-per-call stays a power of two and never exceeds max_rows
+            T = min(
+                _next_pow2(max(x.shape[0] for x in rows)), _prev_pow2(self.max_rows)
+            )
+            # chunk any request longer than one call
+            chunks: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
+            for ri, X in zip(req_ids, rows):
+                yv = requests[ri][2]
+                if yv is None:
+                    Y = X
+                else:
+                    Y = np.asarray(yv, np.float32)
+                    if Y.shape != X.shape:
+                        raise ValueError(
+                            f"Request for {requests[ri][0]!r}: y shape {Y.shape} "
+                            f"must match X shape {X.shape}"
+                        )
+                for start in range(0, X.shape[0], T):
+                    chunks.append((ri, start, X[start : start + T], Y[start : start + T]))
+            B = _next_pow2(len(chunks))
+            Xb = np.zeros((B, T, F), np.float32)
+            Yb = np.zeros((B, T, F), np.float32)
+            idx = np.zeros((B,), np.int32)
+            for ci, (ri, _start, xc, yc) in enumerate(chunks):
+                Xb[ci, : xc.shape[0]] = xc
+                Yb[ci, : yc.shape[0]] = yc
+                idx[ci] = self._index[requests[ri][0]][1]
+            recon, diff, scaled, tot_u, tot_s = bucket.score_batch(idx, Xb, Yb)
+            recon, diff, scaled, tot_u, tot_s = (
+                np.asarray(recon),
+                np.asarray(diff),
+                np.asarray(scaled),
+                np.asarray(tot_u),
+                np.asarray(tot_s),
+            )
+            # reassemble per-request (concatenate chunks in order)
+            per_req: Dict[int, List[int]] = {}
+            for ci, (ri, _s, _x, _y) in enumerate(chunks):
+                per_req.setdefault(ri, []).append(ci)
+            for ri, cis in per_req.items():
+                name, X, _yv = requests[ri]
+                n = X.shape[0]
+                cat = lambda arr: np.concatenate([arr[ci] for ci in cis], axis=0)[:n]
+                results[ri] = ScoreResult(
+                    tags=self._tags[name],
+                    model_input=np.asarray(X, np.float32),
+                    model_output=cat(recon),
+                    diff=cat(diff),
+                    scaled=cat(scaled),
+                    total_unscaled=cat(tot_u),
+                    total_scaled=cat(tot_s),
+                )
+        return results  # type: ignore[return-value]
+
+
+# --------------------------------------------------------------------- #
+# continuous batching
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class _Pending:
+    name: str
+    X: np.ndarray
+    y: Optional[np.ndarray]
+    future: asyncio.Future
+
+
+class BatchingEngine:
+    """Coalesce concurrent scoring requests into batched bank calls.
+
+    Requests arriving while a batch is in flight (or within ``flush_ms`` of
+    the first waiter) are scored together: one XLA dispatch for up to
+    ``max_batch`` models' requests. XLA execution runs in a thread-pool
+    executor so the event loop keeps accepting requests — continuous
+    batching in the LLM-serving sense, applied to anomaly scoring.
+    """
+
+    def __init__(self, bank: ModelBank, max_batch: int = 64, flush_ms: float = 2.0):
+        self.bank = bank
+        self.max_batch = int(max_batch)
+        self.flush_s = float(flush_ms) / 1e3
+        self._queue: "asyncio.Queue[_Pending]" = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self.stats = {"requests": 0, "batches": 0, "max_batch_seen": 0}
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def score(
+        self, name: str, X: np.ndarray, y: Optional[np.ndarray] = None
+    ) -> ScoreResult:
+        self.start()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put(_Pending(name, X, y, fut))
+        return await fut
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = time.monotonic() + self.flush_s
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self._queue.get(), timeout=timeout)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            self.stats["requests"] += len(batch)
+            self.stats["batches"] += 1
+            self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], len(batch))
+            requests = [(p.name, p.X, p.y) for p in batch]
+            try:
+                results = await loop.run_in_executor(
+                    None, self.bank.score_many, requests
+                )
+            except Exception:
+                # one bad request must not poison the batch: retry each
+                # request alone so errors land only on their own future
+                for p in batch:
+                    try:
+                        r = await loop.run_in_executor(
+                            None, self.bank.score, p.name, p.X, p.y
+                        )
+                    except Exception as exc:
+                        if not p.future.done():
+                            p.future.set_exception(exc)
+                    else:
+                        if not p.future.done():
+                            p.future.set_result(r)
+                continue
+            for p, r in zip(batch, results):
+                if not p.future.done():
+                    p.future.set_result(r)

@@ -46,6 +46,14 @@ def _get_model(request: web.Request):
     return collection[target], collection.metadata[target]
 
 
+def _bank_engine(request: web.Request):
+    """The continuous-batching engine, if the target is bank-resident."""
+    engine = request.app.get("bank_engine")
+    if engine is not None and request.match_info["target"] in engine.bank:
+        return engine
+    return None
+
+
 @routes.get("/gordo/v0/{project}/models")
 async def list_models(request: web.Request) -> web.Response:
     return web.json_response(
@@ -103,9 +111,18 @@ async def prediction(request: web.Request) -> web.Response:
         raise web.HTTPBadRequest(
             text=json.dumps({"error": str(exc)}), content_type="application/json"
         )
-    loop = asyncio.get_running_loop()
+    engine = _bank_engine(request)
     try:
-        output = await loop.run_in_executor(None, model.predict, X.values.astype("float32"))
+        if engine is not None:
+            result = await engine.score(
+                request.match_info["target"], X.values.astype("float32")
+            )
+            output = result.model_output
+        else:
+            loop = asyncio.get_running_loop()
+            output = await loop.run_in_executor(
+                None, model.predict, X.values.astype("float32")
+            )
     except Exception as exc:  # surface model errors as 400s with detail
         logger.exception("prediction failed")
         raise web.HTTPBadRequest(
@@ -135,9 +152,18 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         raise web.HTTPBadRequest(
             text=json.dumps({"error": str(exc)}), content_type="application/json"
         )
-    loop = asyncio.get_running_loop()
+    engine = _bank_engine(request)
     try:
-        frame = await loop.run_in_executor(None, model.anomaly, X, y)
+        if engine is not None:
+            result = await engine.score(
+                request.match_info["target"],
+                X.values.astype("float32"),
+                None if y is None else y.values.astype("float32"),
+            )
+            frame = result.to_frame(index=X.index)
+        else:
+            loop = asyncio.get_running_loop()
+            frame = await loop.run_in_executor(None, model.anomaly, X, y)
     except Exception as exc:
         logger.exception("anomaly scoring failed")
         raise web.HTTPBadRequest(

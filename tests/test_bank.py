@@ -1,0 +1,168 @@
+"""Model-bank tests: stacked HBM-resident scoring must be frame-identical
+to the per-model ``DiffBasedAnomalyDetector.anomaly`` path (the two share
+``assemble_anomaly_frame``), and the continuous-batching engine must
+coalesce concurrent requests without changing results."""
+
+import asyncio
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_components_tpu.models import (
+    AutoEncoder,
+    DiffBasedAnomalyDetector,
+    LSTMAutoEncoder,
+)
+from gordo_components_tpu.models.transformers import JaxMinMaxScaler
+from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
+
+
+def _make_det(Xv, scaler=None, **ae_kwargs):
+    kwargs = dict(epochs=2, batch_size=64)
+    kwargs.update(ae_kwargs)
+    ae = AutoEncoder(**kwargs)
+    base = Pipeline([("scale", scaler), ("model", ae)]) if scaler is not None else ae
+    det = DiffBasedAnomalyDetector(base_estimator=base)
+    det.fit(Xv)
+    return det
+
+
+@pytest.fixture(scope="module")
+def fleet_models():
+    rng = np.random.RandomState(0)
+    X3 = rng.rand(150, 3).astype("float32")
+    X5 = rng.rand(150, 5).astype("float32")
+    return {
+        "plain": _make_det(X3),
+        "jax-scaled": _make_det(X3, scaler=JaxMinMaxScaler()),
+        "sk-scaled": _make_det(X3, scaler=MinMaxScaler()),
+        "wide": _make_det(X5),
+    }, {"plain": X3, "jax-scaled": X3, "sk-scaled": X3, "wide": X5}
+
+
+def test_bank_membership_and_buckets(fleet_models):
+    models, _ = fleet_models
+    lstm = DiffBasedAnomalyDetector(
+        base_estimator=LSTMAutoEncoder(lookback_window=5, epochs=1, batch_size=32)
+    )
+    lstm.fit(np.random.RandomState(1).rand(60, 3).astype("float32"))
+    bank = ModelBank.from_models({**models, "lstm": lstm})
+    assert len(bank) == 4  # lstm is not bankable
+    assert "lstm" not in bank
+    assert all(name in bank for name in models)
+    # 3-feature models share a bucket; the 5-feature model gets its own
+    assert bank.n_buckets == 2
+
+
+@pytest.mark.parametrize("name", ["plain", "jax-scaled", "sk-scaled", "wide"])
+def test_bank_scoring_matches_per_model_path(fleet_models, name):
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    X = data[name][:37]  # odd length -> exercises padding
+    expected = models[name].anomaly(X)
+    got = bank.score(name, X).to_frame()
+    pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_bank_scoring_with_y(fleet_models):
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    X = data["jax-scaled"][:20]
+    y = X + 0.1
+    expected = models["jax-scaled"].anomaly(X, y)
+    got = bank.score("jax-scaled", X, y).to_frame()
+    pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_score_many_mixed_buckets_and_chunking(fleet_models):
+    models, data = fleet_models
+    bank = ModelBank.from_models(models, max_rows_per_call=16)
+    requests = [
+        ("plain", data["plain"][:50], None),  # chunked: 50 rows > 16
+        ("wide", data["wide"][:7], None),
+        ("sk-scaled", data["sk-scaled"][:16], None),
+    ]
+    results = bank.score_many(requests)
+    for (name, X, _), res in zip(requests, results):
+        assert res.model_output.shape == X.shape
+        expected = models[name].anomaly(X)
+        pd.testing.assert_frame_equal(
+            res.to_frame(), expected, rtol=1e-4, atol=1e-5
+        )
+
+
+def test_bank_rejects_wrong_shape_and_unknown(fleet_models):
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    with pytest.raises(KeyError):
+        bank.score("ghost", data["plain"][:5])
+    with pytest.raises(ValueError):
+        bank.score("plain", data["wide"][:5])  # 5 features into 3-feature model
+    with pytest.raises(ValueError):
+        bank.score("plain", data["plain"][:0])  # empty input
+    with pytest.raises(ValueError):
+        bank.score("plain", data["plain"][:10], y=data["plain"][:4])  # short y
+
+
+def test_bank_respects_compute_dtype(fleet_models):
+    """bf16 and f32 models with identical kwargs must not share a bucket,
+    and bf16 bank scoring must match the bf16 per-model path."""
+    _, data = fleet_models
+    X = data["plain"]
+    det16 = _make_det(X, compute_dtype="bfloat16")
+    det32 = _make_det(X)
+    bank = ModelBank.from_models({"bf16": det16, "f32": det32})
+    assert bank.n_buckets == 2
+    expected = det16.anomaly(X[:21])
+    got = bank.score("bf16", X[:21]).to_frame()
+    pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_bank_max_rows_cap_not_pow2():
+    from gordo_components_tpu.server.bank import _prev_pow2
+
+    assert _prev_pow2(5000) == 4096
+    assert _prev_pow2(4096) == 4096
+    assert _prev_pow2(1) == 1
+
+
+async def test_batching_engine_coalesces(fleet_models):
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    engine = BatchingEngine(bank, max_batch=8, flush_ms=20.0)
+    try:
+        names = ["plain", "jax-scaled", "sk-scaled", "wide"] * 3
+        results = await asyncio.gather(
+            *(engine.score(n, data[n][:10]) for n in names)
+        )
+        for n, res in zip(names, results):
+            expected = models[n].anomaly(data[n][:10])
+            pd.testing.assert_frame_equal(
+                res.to_frame(), expected, rtol=1e-4, atol=1e-5
+            )
+        assert engine.stats["requests"] == len(names)
+        # coalescing happened: fewer XLA dispatch rounds than requests
+        assert engine.stats["batches"] < len(names)
+        assert engine.stats["max_batch_seen"] > 1
+    finally:
+        await engine.stop()
+
+
+async def test_batching_engine_propagates_errors(fleet_models):
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    engine = BatchingEngine(bank, max_batch=4, flush_ms=5.0)
+    try:
+        good, bad = await asyncio.gather(
+            engine.score("plain", data["plain"][:5]),
+            engine.score("plain", data["wide"][:5]),  # wrong width
+            return_exceptions=True,
+        )
+        # one request's bad shape must not poison the good one
+        assert not isinstance(good, Exception)
+        assert isinstance(bad, ValueError)
+    finally:
+        await engine.stop()

@@ -7,7 +7,6 @@ from aiohttp.test_utils import TestClient, TestServer
 
 from gordo_components_tpu import serializer
 from gordo_components_tpu.models import AutoEncoder
-from gordo_components_tpu.server import build_app
 from gordo_components_tpu.watchman.server import WatchmanState, build_watchman_app
 
 
