@@ -135,12 +135,17 @@ def build_fleet(
     output_dir: str,
     model_register_dir: Optional[str] = None,
     replace_cache: bool = False,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
 ) -> Dict[str, str]:
     """Build every machine; returns name -> artifact dir.
 
     Fleetable machines with identical AutoEncoder kwargs train together in
     one FleetTrainer program; everything else falls back to the single-model
     builder. Cache semantics (config-hash keyed) apply to both paths.
+    ``checkpoint_dir`` enables mid-training preemption recovery for the
+    fleet groups (parallel/checkpoint.py): a restarted gang resumes its
+    interrupted epoch loop instead of retraining from scratch.
     """
     results: Dict[str, str] = {}
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
@@ -165,7 +170,8 @@ def build_fleet(
 
     for _, group in fleet_groups.items():
         _build_fleet_group(
-            group, output_dir, model_register_dir, replace_cache, results
+            group, output_dir, model_register_dir, replace_cache, results,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
         )
     return results
 
@@ -176,6 +182,8 @@ def _build_fleet_group(
     model_register_dir: Optional[str],
     replace_cache: bool,
     results: Dict[str, str],
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
 ) -> None:
     ae_kwargs = copy.deepcopy(group[0][1])
 
@@ -208,7 +216,10 @@ def _build_fleet_group(
     trainer_kwargs = {
         k: ae_kwargs.pop(k) for k in _TRAINER_KEYS if k in ae_kwargs
     }
-    trainer = FleetTrainer(**trainer_kwargs, **ae_kwargs)
+    trainer = FleetTrainer(
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        **trainer_kwargs, **ae_kwargs,
+    )
     t1 = time.time()
     fleet_models = trainer.fit(member_data)
     train_elapsed = time.time() - t1

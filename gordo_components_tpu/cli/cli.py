@@ -88,7 +88,13 @@ def build(name, model_config, data_config, metadata, output_dir, model_register_
               help="JSON/YAML file: gang payload or {machines: [...]}")
 @click.option("--output-dir", envvar="OUTPUT_DIR", default="./model-output")
 @click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
-def build_fleet_cmd(machines_file, output_dir, model_register_dir):
+@click.option("--checkpoint-dir", envvar="CHECKPOINT_DIR", default=None,
+              help="Enable mid-training preemption recovery for fleet groups")
+@click.option("--checkpoint-every", envvar="CHECKPOINT_EVERY", default=1, type=int,
+              help="Epochs between fleet checkpoints (amortizes the "
+                   "device-to-host state gather for large buckets)")
+def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_dir,
+                    checkpoint_every):
     """Build a gang of machines in one process (TPU fleet engine)."""
     from gordo_components_tpu.builder.fleet_build import build_fleet
     from gordo_components_tpu.workflow.config import Machine
@@ -116,7 +122,8 @@ def build_fleet_cmd(machines_file, output_dir, model_register_dir):
         sys.exit(EXIT_CONFIG_ERROR)
     try:
         results = build_fleet(
-            machines, output_dir, model_register_dir=model_register_dir
+            machines, output_dir, model_register_dir=model_register_dir,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
         )
     except Exception as exc:
         click.echo(f"Fleet build failed: {exc}", err=True)

@@ -1,0 +1,132 @@
+"""Preemption-safe checkpointing for fleet training.
+
+The reference has no mid-training checkpointing — its unit of persistence
+is the finished artifact, and a killed builder pod simply reruns from
+scratch (SURVEY.md §5 "Checkpoint / resume"). That is tolerable when one
+pod trains one small model; a gang job training a 10k-model bucket on a
+TPU sub-mesh loses hours on preemption. This module gives the fleet engine
+what the reference couldn't: every N epochs the *stacked* training state —
+one pytree holding all models' params/opt-state/rng plus the host-side
+early-stopping bookkeeping — is written through orbax, and a restarted gang
+resumes exactly where it stopped (same on-device shuffle stream, since the
+PRNG keys live inside the saved TrainState).
+
+Layout under ``checkpoint_dir``::
+
+    <bucket_key>/<epoch>/state/     orbax pytree (TrainState stack [+ best])
+    <bucket_key>/<epoch>/host.json  epoch counter + early-stop bookkeeping
+
+Each save writes a NEW ``<epoch>`` directory and commits it by writing
+``host.json`` last; older epoch dirs are pruned only after the new one is
+complete. A preemption mid-save therefore never destroys the previous good
+checkpoint — restore() simply picks the newest committed epoch. The
+``bucket_key`` hashes the full bucket identity (architecture, member names,
+training data content, hyperparameters), so any config, membership, or
+data change invalidates the checkpoint instead of resuming into the wrong
+training run.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def bucket_checkpoint_key(payload: Any, data: Optional[np.ndarray] = None) -> str:
+    """Stable identity hash for a fleet bucket's training run.
+
+    ``data`` (the stacked member array) is content-hashed in so a resumed
+    run is guaranteed to be training on the same bytes it was preempted on
+    — config hashes alone cannot see a changed data window that happens to
+    pad to the same shape.
+    """
+    h = hashlib.sha256(json.dumps(payload, sort_keys=True, default=str).encode())
+    if data is not None:
+        h.update(np.ascontiguousarray(data).tobytes())
+    return h.hexdigest()[:24]
+
+
+class FleetBucketCheckpoint:
+    """Save/restore one bucket's mid-training state via orbax."""
+
+    def __init__(self, checkpoint_dir: str, key: str):
+        self.root = os.path.join(os.path.abspath(checkpoint_dir), key)
+
+    # ------------------------------------------------------------------ #
+
+    def _epoch_dirs(self):
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for entry in os.listdir(self.root):
+            try:
+                out.append(int(entry))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def _committed_epochs(self):
+        return [
+            e
+            for e in self._epoch_dirs()
+            if os.path.exists(os.path.join(self.root, str(e), "host.json"))
+        ]
+
+    def save(self, epoch: int, state_pytree: Any, host_state: Dict[str, Any]) -> None:
+        """Persist after ``epoch`` completed.
+
+        Writes a fresh ``<epoch>`` dir (state first, ``host.json`` commit
+        marker last) and only then prunes older epochs, so the previous
+        good checkpoint survives a preemption mid-save.
+        """
+        import orbax.checkpoint as ocp
+
+        edir = os.path.join(self.root, str(int(epoch)))
+        if os.path.isdir(edir):  # stale torn save from a previous attempt
+            shutil.rmtree(edir)
+        os.makedirs(edir)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(
+                os.path.join(edir, "state"),
+                jax.tree.map(np.asarray, state_pytree),
+            )
+        host_path = os.path.join(edir, "host.json")
+        with open(host_path + ".tmp", "w") as f:
+            json.dump({"epoch": int(epoch), **host_state}, f)
+        os.replace(host_path + ".tmp", host_path)  # commit
+        for old in self._epoch_dirs():
+            if old != int(epoch):
+                shutil.rmtree(os.path.join(self.root, str(old)), ignore_errors=True)
+        logger.info("Fleet checkpoint saved at epoch %d -> %s", epoch, edir)
+
+    def restore(self) -> Optional[Dict[str, Any]]:
+        """Returns ``{"epoch": int, "state": pytree, **host_state}`` with
+        numpy leaves from the newest committed epoch, or None."""
+        import orbax.checkpoint as ocp
+
+        for epoch in reversed(self._committed_epochs()):
+            edir = os.path.join(self.root, str(epoch))
+            try:
+                with open(os.path.join(edir, "host.json")) as f:
+                    host = json.load(f)
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    state = ckptr.restore(os.path.join(edir, "state"))
+            except Exception:
+                logger.warning("Unreadable fleet checkpoint at %s; skipping", edir)
+                continue
+            host["state"] = state
+            logger.info("Resuming fleet bucket from %s (epoch %d done)", edir, epoch)
+            return host
+        return None
+
+    def clear(self) -> None:
+        """Remove the checkpoint (bucket finished; artifact is persistence now)."""
+        if os.path.isdir(self.root):
+            shutil.rmtree(self.root, ignore_errors=True)

@@ -127,6 +127,9 @@ class FleetTrainer:
         seed: int = 0,
         mesh=None,
         compute_dtype: str = "float32",
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
+        epoch_callback=None,
         **factory_kwargs,
     ):
         self.kind = kind
@@ -139,6 +142,13 @@ class FleetTrainer:
         self.seed = int(seed)
         self.mesh = mesh
         self.compute_dtype = compute_dtype
+        # preemption recovery: when set, stacked train state is checkpointed
+        # every ``checkpoint_every`` epochs and fit() resumes a matching
+        # interrupted run (parallel/checkpoint.py)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        # epoch_callback(info_dict) after every epoch: progress/metrics hook
+        self.epoch_callback = epoch_callback
         self.factory_kwargs = factory_kwargs
         self.last_stats: Dict[str, Any] = {}
 
@@ -244,6 +254,7 @@ class FleetTrainer:
         sample = Xd[:, 0, :]  # (M, n_features)
         init_stacked = jax.jit(jax.vmap(init_fn))
         states = init_stacked(rngs, sample)
+        state_treedef = jax.tree.structure(states)
 
         def masked_epoch(state, X, mask, active):
             new_state, loss = epoch_fn(state, X, X, mask)
@@ -279,7 +290,96 @@ class FleetTrainer:
 
                 return jax.tree.map(sel, best_p, new_p)
 
-        for epoch in range(self.epochs):
+        # ---- preemption recovery: resume a matching interrupted run ----
+        ckpt = None
+        start_epoch = 0
+        if self.checkpoint_dir:
+            from gordo_components_tpu.parallel.checkpoint import (
+                FleetBucketCheckpoint,
+                bucket_checkpoint_key,
+            )
+
+            key = bucket_checkpoint_key(
+                [
+                    self.kind,
+                    sorted(self.factory_kwargs.items()),
+                    self.compute_dtype,
+                    n_features,
+                    padded_rows,
+                    list(names),
+                    self.epochs,
+                    self.batch_size,
+                    self.learning_rate,
+                    self.optimizer,
+                    self.early_stopping_patience,
+                    self.early_stopping_min_delta,
+                    self.seed,
+                    int(mesh.shape[MODEL_AXIS]),
+                ],
+                data=Xs,  # content hash: same-shaped but different data must not resume
+            )
+            ckpt = FleetBucketCheckpoint(self.checkpoint_dir, key)
+            resumed = ckpt.restore()
+            if resumed is not None:
+                try:
+                    restore_leaves = lambda d: [
+                        jax.device_put(jnp.asarray(d[str(i)]), sharding)
+                        for i in range(len(d))
+                    ]
+                    states = jax.tree.unflatten(
+                        state_treedef, restore_leaves(resumed["state"]["state"])
+                    )
+                    if "best" in resumed["state"]:
+                        best_params = jax.tree.unflatten(
+                            jax.tree.structure(states.params),
+                            restore_leaves(resumed["state"]["best"]),
+                        )
+                    active = np.asarray(resumed["active"], np.float32)
+                    best = np.asarray(resumed["best"], np.float64)
+                    patience = np.asarray(resumed["patience"], np.int64)
+                    histories = [list(h) for h in resumed["histories"]]
+                    start_epoch = int(resumed["epoch"]) + 1
+                except Exception:
+                    # e.g. a library upgrade changed the opt-state pytree
+                    # structure between preemption and restart: start fresh
+                    # rather than crash every restarted gang
+                    logger.warning(
+                        "Fleet checkpoint structure mismatch; training from scratch",
+                        exc_info=True,
+                    )
+                    states = init_stacked(rngs, sample)
+                    best_params = None
+                    active = np.ones((M,), dtype=np.float32)
+                    best = np.full((M,), np.inf)
+                    patience = np.full(
+                        (M,),
+                        self.early_stopping_patience if es_enabled else -1,
+                        dtype=np.int64,
+                    )
+                    histories = [[] for _ in range(M)]
+                    start_epoch = 0
+
+        def save_checkpoint(epoch):
+            tosave = {"state": dict(
+                (str(i), leaf) for i, leaf in enumerate(jax.tree.leaves(states))
+            )}
+            if best_params is not None:
+                tosave["best"] = dict(
+                    (str(i), leaf)
+                    for i, leaf in enumerate(jax.tree.leaves(best_params))
+                )
+            ckpt.save(
+                epoch,
+                tosave,
+                {
+                    "active": active.tolist(),
+                    "best": best.tolist(),
+                    "patience": patience.tolist(),
+                    "histories": histories,
+                },
+            )
+
+        for epoch in range(start_epoch, self.epochs):
             states, losses = run_epoch(states, Xd, maskd, jnp.asarray(active))
             losses = np.asarray(losses)
             for i in range(M):
@@ -305,9 +405,25 @@ class FleetTrainer:
                 active = np.where(
                     (patience <= 0) & ~improved, 0.0, active
                 ).astype(np.float32)
-                if not active.any():
-                    logger.info("All %d models early-stopped at epoch %d", M, epoch + 1)
-                    break
+            if self.epoch_callback is not None:
+                self.epoch_callback(
+                    {
+                        "n_features": n_features,
+                        "padded_rows": padded_rows,
+                        "epoch": epoch,
+                        "losses": losses[: len(names)],
+                        "n_active": int((active > 0).sum()),
+                    }
+                )
+            if (
+                ckpt is not None
+                and (epoch + 1) % self.checkpoint_every == 0
+                and epoch + 1 < self.epochs
+            ):
+                save_checkpoint(epoch)
+            if es_enabled and not active.any():
+                logger.info("All %d models early-stopped at epoch %d", M, epoch + 1)
+                break
 
         final_params = best_params if best_params is not None else states.params
 
@@ -361,4 +477,9 @@ class FleetTrainer:
                 feature_thresholds=feat_thresh[i],
                 total_threshold=float(total_thresh[i]),
             )
+        # clear only once results are unstacked on host: a preemption during
+        # the error-scaler pass / unstacking above can still resume from the
+        # last epoch checkpoint instead of retraining from scratch
+        if ckpt is not None:
+            ckpt.clear()
         return out

@@ -1,0 +1,154 @@
+"""Fleet checkpoint/resume tests: an interrupted fleet training run must
+resume from its last checkpoint and converge to the same result as an
+uninterrupted run (the saved TrainState carries the PRNG stream, so the
+on-device shuffles replay identically)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.parallel.checkpoint import (
+    FleetBucketCheckpoint,
+    bucket_checkpoint_key,
+)
+from gordo_components_tpu.parallel.fleet import FleetTrainer
+
+
+def _members(n=6, rows=64, f=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return {f"m-{i}": rng.rand(rows, f).astype("float32") for i in range(n)}
+
+
+class _Preempt(Exception):
+    pass
+
+
+def _kill_after(n_epochs):
+    calls = {"count": 0}
+
+    def cb(info):
+        calls["count"] += 1
+        if calls["count"] >= n_epochs:
+            raise _Preempt(f"simulated preemption after epoch {info['epoch']}")
+
+    return cb
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    members = _members()
+    common = dict(kind="feedforward_hourglass", epochs=6, batch_size=32, seed=3)
+
+    reference = FleetTrainer(**common).fit(members)
+
+    ckdir = str(tmp_path / "ck")
+    t1 = FleetTrainer(
+        **common, checkpoint_dir=ckdir, checkpoint_every=1,
+        epoch_callback=_kill_after(3),
+    )
+    with pytest.raises(_Preempt):
+        t1.fit(members)
+    assert os.listdir(ckdir), "checkpoint must exist after preemption"
+
+    t2 = FleetTrainer(**common, checkpoint_dir=ckdir, checkpoint_every=1)
+    resumed = t2.fit(members)
+
+    for name in members:
+        ref, got = reference[name], resumed[name]
+        # full 6-epoch history: 3 before the kill + 3 after resume
+        assert len(got.history["loss"]) == 6
+        np.testing.assert_allclose(
+            got.history["loss"], ref.history["loss"], rtol=1e-5
+        )
+        ref_leaves = [np.asarray(x) for x in _leaves(ref.params)]
+        got_leaves = [np.asarray(x) for x in _leaves(got.params)]
+        for a, b in zip(ref_leaves, got_leaves):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    # finished run cleans its checkpoint up
+    assert not any(os.scandir(ckdir)) or all(
+        not any(os.scandir(e.path)) for e in os.scandir(ckdir)
+    )
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def test_resume_with_early_stopping_state(tmp_path):
+    members = _members(n=4)
+    common = dict(
+        kind="feedforward_hourglass", epochs=8, batch_size=32, seed=1,
+        early_stopping_patience=2,
+    )
+    reference = FleetTrainer(**common).fit(members)
+
+    ckdir = str(tmp_path / "ck")
+    t1 = FleetTrainer(
+        **common, checkpoint_dir=ckdir, epoch_callback=_kill_after(4)
+    )
+    with pytest.raises(_Preempt):
+        t1.fit(members)
+    resumed = FleetTrainer(**common, checkpoint_dir=ckdir).fit(members)
+    for name in members:
+        assert resumed[name].history["loss"] == pytest.approx(
+            reference[name].history["loss"], rel=1e-5
+        )
+
+
+def test_config_change_invalidates_checkpoint(tmp_path):
+    members = _members(n=2)
+    ckdir = str(tmp_path / "ck")
+    t1 = FleetTrainer(
+        kind="feedforward_hourglass", epochs=4, batch_size=32,
+        checkpoint_dir=ckdir, epoch_callback=_kill_after(2),
+    )
+    with pytest.raises(_Preempt):
+        t1.fit(members)
+    # different lr -> different bucket key -> fresh run, full history
+    t2 = FleetTrainer(
+        kind="feedforward_hourglass", epochs=4, batch_size=32,
+        learning_rate=5e-4, checkpoint_dir=ckdir,
+    )
+    out = t2.fit(members)
+    assert all(len(m.history["loss"]) == 4 for m in out.values())
+
+
+def test_torn_checkpoint_ignored(tmp_path):
+    key = bucket_checkpoint_key(["anything"])
+    ck = FleetBucketCheckpoint(str(tmp_path), key)
+    # epoch dir with state but no host.json commit marker == torn save
+    os.makedirs(os.path.join(ck.root, "3", "state"))
+    assert ck.restore() is None
+
+
+def test_previous_checkpoint_survives_torn_save(tmp_path):
+    """A preemption mid-save must not destroy the last good checkpoint."""
+    key = bucket_checkpoint_key(["x"])
+    ck = FleetBucketCheckpoint(str(tmp_path), key)
+    ck.save(2, {"a": np.ones((2, 3), np.float32)}, {"active": [1.0]})
+    # torn save of epoch 3: state written, host.json never committed
+    os.makedirs(os.path.join(ck.root, "3", "state"))
+    restored = ck.restore()
+    assert restored is not None and restored["epoch"] == 2
+    np.testing.assert_array_equal(restored["state"]["a"], np.ones((2, 3)))
+    # a later complete save prunes both the old epoch and the torn one
+    ck.save(3, {"a": np.zeros((2, 3), np.float32)}, {"active": [1.0]})
+    assert sorted(os.listdir(ck.root)) == ["3"]
+    assert ck.restore()["epoch"] == 3
+
+
+def test_data_change_invalidates_key():
+    payload = ["same", "config"]
+    a = bucket_checkpoint_key(payload, data=np.ones((4, 8), np.float32))
+    b = bucket_checkpoint_key(payload, data=np.ones((4, 8), np.float32))
+    c = bucket_checkpoint_key(payload, data=np.full((4, 8), 2.0, np.float32))
+    assert a == b != c
+
+
+def test_key_stability():
+    a = bucket_checkpoint_key(["x", 1, ["m1", "m2"]])
+    b = bucket_checkpoint_key(["x", 1, ["m1", "m2"]])
+    c = bucket_checkpoint_key(["x", 1, ["m1", "m3"]])
+    assert a == b != c
