@@ -34,14 +34,14 @@ from gordo_components_tpu.utils import capture_args
 logger = logging.getLogger(__name__)
 
 
-@jax.jit
 def _score_fn(err_scale: ScalerParams, target: jnp.ndarray, output: jnp.ndarray):
-    """diff -> (abs diff, scaled abs diff, total norms). One XLA program."""
-    diff = jnp.abs(target - output)
-    scaled = scaler_transform(err_scale, diff)
-    total_unscaled = jnp.linalg.norm(diff, axis=-1)
-    total_scaled = jnp.linalg.norm(scaled, axis=-1)
-    return diff, scaled, total_unscaled, total_scaled
+    """diff -> (abs diff, scaled abs diff, total norms). One program:
+    a fused Pallas pass on TPU, the same math via jit'd XLA elsewhere
+    (ops/pallas_score.py — dispatch happens outside jit so a kernel
+    compile failure can fall back cleanly)."""
+    from gordo_components_tpu.ops.pallas_score import fused_anomaly_score
+
+    return fused_anomaly_score(target, output, err_scale.shift, err_scale.scale)
 
 
 def assemble_anomaly_frame(

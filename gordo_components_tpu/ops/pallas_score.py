@@ -1,0 +1,168 @@
+"""Fused anomaly-scoring epilogue as a Pallas TPU kernel.
+
+The server's per-request hot loop (SURVEY.md §3.2; reference:
+``DiffBasedAnomalyDetector.anomaly``) ends in an elementwise epilogue over
+the reconstruction: ``|target - output|``, the per-feature error scaling,
+and two row norms. As four separate XLA ops this reads the (rows, F)
+operands from HBM several times and writes four results back; the Pallas
+kernel streams each row tile through VMEM exactly once and emits all four
+outputs from that single pass — one HBM read per operand, four writes,
+zero intermediate round-trips.
+
+Usage is transparent: :func:`fused_anomaly_score` dispatches to the kernel
+on TPU backends and to an identical pure-jnp implementation elsewhere
+(tests run it in interpreter mode via ``interpret=True`` to exercise the
+kernel logic on CPU). Feature/row padding to hardware tiles (8 sublanes x
+128 lanes for f32) happens in the wrapper; padded feature lanes are masked
+inside the kernel so they contribute nothing to the scaled errors or the
+norms.
+"""
+
+import functools
+import logging
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+ROW_TILE = 256  # rows per grid step (multiple of the 8-sublane f32 tile)
+LANE = 128
+
+
+@jax.jit
+def _jnp_score(target, output, shift, scale):
+    """Reference implementation (also the non-TPU fallback)."""
+    diff = jnp.abs(target - output)
+    scaled = (diff - shift) * scale
+    tot_u = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    tot_s = jnp.sqrt(jnp.sum(scaled * scaled, axis=-1))
+    return diff, scaled, tot_u, tot_s
+
+
+def _kernel(n_features: int, t_ref, o_ref, shift_ref, scale_ref,
+            diff_ref, scaled_ref, tu_ref, ts_ref):
+    t = t_ref[:]
+    o = o_ref[:]
+    diff = jnp.abs(t - o)
+    # feature lanes beyond n_features are padding: zero them so the scaled
+    # error's affine shift doesn't leak into the norms
+    mask = jax.lax.broadcasted_iota(jnp.int32, diff.shape, 1) < n_features
+    diff = jnp.where(mask, diff, 0.0)
+    scaled = jnp.where(mask, (diff - shift_ref[:]) * scale_ref[:], 0.0)
+    diff_ref[:] = diff
+    scaled_ref[:] = scaled
+    tu_ref[:] = jnp.sqrt(jnp.sum(diff * diff, axis=1, keepdims=True))
+    ts_ref[:] = jnp.sqrt(jnp.sum(scaled * scaled, axis=1, keepdims=True))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_score(target, output, shift, scale, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, F = target.shape
+    Fp = -(-F // LANE) * LANE
+    Rp = -(-rows // ROW_TILE) * ROW_TILE
+
+    pad2 = lambda a: jnp.pad(a, ((0, Rp - rows), (0, Fp - F)))
+    t = pad2(target.astype(jnp.float32))
+    o = pad2(output.astype(jnp.float32))
+    row_vec = lambda v: jnp.pad(v.astype(jnp.float32), (0, Fp - F))[None, :]
+    sh, sc = row_vec(shift), row_vec(scale)
+
+    grid = (Rp // ROW_TILE,)
+    tile = lambda: pl.BlockSpec(
+        (ROW_TILE, Fp), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    const = lambda: pl.BlockSpec((1, Fp), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    diff, scaled, tu, ts = pl.pallas_call(
+        functools.partial(_kernel, F),
+        grid=grid,
+        in_specs=[tile(), tile(), const(), const()],
+        out_specs=[
+            tile(),
+            tile(),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Rp, Fp), jnp.float32),
+            jax.ShapeDtypeStruct((Rp, Fp), jnp.float32),
+            jax.ShapeDtypeStruct((Rp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((Rp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(t, o, sh, sc)
+    return (
+        diff[:rows, :F],
+        scaled[:rows, :F],
+        tu[:rows, 0],
+        ts[:rows, 0],
+    )
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+_pallas_disabled = False  # sticky only when the kernel NEVER worked (compile)
+_pallas_ever_worked = False
+_transient_warned = False
+
+
+def fused_anomaly_score(
+    target: jnp.ndarray,
+    output: jnp.ndarray,
+    shift: jnp.ndarray,
+    scale: jnp.ndarray,
+    force: str = "auto",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``(diff, scaled, total_unscaled, total_scaled)`` for a (rows, F)
+    reconstruction — one fused pass on TPU, identical jnp math elsewhere.
+
+    ``force``: "auto" (TPU -> kernel, else jnp), "pallas" (compiled
+    kernel, errors propagate), "interpret" (kernel in interpreter mode,
+    any backend), "jnp" (pure fallback). In "auto" mode a failure before
+    the kernel has ever worked on this backend (a compile problem)
+    disables it for the process; a failure after it has worked (e.g. a
+    transient allocation error on one oversized request) falls back for
+    that call only.
+    """
+    global _pallas_disabled, _pallas_ever_worked, _transient_warned
+    if force == "jnp" or (
+        force == "auto" and (_pallas_disabled or not _on_tpu())
+    ):
+        return _jnp_score(target, output, shift, scale)
+    if force == "interpret":
+        return _pallas_score(target, output, shift, scale, interpret=True)
+    try:
+        out = _pallas_score(target, output, shift, scale)
+        _pallas_ever_worked = True
+        return out
+    except Exception:
+        if force != "auto":
+            raise
+        if not _pallas_ever_worked:
+            _pallas_disabled = True
+            logger.warning(
+                "Pallas scoring kernel failed to compile on backend %r; "
+                "using XLA for the rest of this process",
+                jax.default_backend(),
+                exc_info=True,
+            )
+        elif not _transient_warned:
+            _transient_warned = True
+            logger.warning(
+                "Pallas scoring kernel failed transiently; falling back to "
+                "XLA for this call (further occurrences logged at DEBUG)",
+                exc_info=True,
+            )
+        else:
+            logger.debug("Pallas scoring kernel transient failure", exc_info=True)
+        return _jnp_score(target, output, shift, scale)

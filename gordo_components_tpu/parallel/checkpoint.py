@@ -39,17 +39,23 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
-def bucket_checkpoint_key(payload: Any, data: Optional[np.ndarray] = None) -> str:
+def bucket_checkpoint_key(payload: Any, data=None) -> str:
     """Stable identity hash for a fleet bucket's training run.
 
-    ``data`` (the stacked member array) is content-hashed in so a resumed
-    run is guaranteed to be training on the same bytes it was preempted on
-    — config hashes alone cannot see a changed data window that happens to
-    pad to the same shape.
+    ``data`` (an iterable of per-member arrays, or one array) is
+    content-hashed in so a resumed run is guaranteed to be training on the
+    same bytes it was preempted on — config hashes alone cannot see a
+    changed data window that happens to pad to the same shape. Hashing
+    streams member-by-member: no stacked-copy materialization.
     """
     h = hashlib.sha256(json.dumps(payload, sort_keys=True, default=str).encode())
     if data is not None:
-        h.update(np.ascontiguousarray(data).tobytes())
+        if isinstance(data, np.ndarray):
+            data = [data]
+        for arr in data:
+            arr = np.ascontiguousarray(arr)
+            h.update(str(arr.shape).encode())
+            h.update(memoryview(arr).cast("B"))
     return h.hexdigest()[:24]
 
 
@@ -126,7 +132,27 @@ class FleetBucketCheckpoint:
             return host
         return None
 
-    def clear(self) -> None:
-        """Remove the checkpoint (bucket finished; artifact is persistence now)."""
+    def clear(self, prune_stale_after_days: Optional[float] = 7.0) -> None:
+        """Remove the checkpoint (bucket finished; artifact is persistence
+        now). Also prunes *sibling* keys untouched for
+        ``prune_stale_after_days`` — checkpoints stranded by a config/data
+        change (their key will never be computed again) would otherwise
+        accumulate forever on a shared checkpoint volume."""
         if os.path.isdir(self.root):
             shutil.rmtree(self.root, ignore_errors=True)
+        if prune_stale_after_days is None:
+            return
+        import time
+
+        parent = os.path.dirname(self.root)
+        if not os.path.isdir(parent):
+            return
+        cutoff = time.time() - prune_stale_after_days * 86400
+        for entry in os.listdir(parent):
+            path = os.path.join(parent, entry)
+            try:
+                if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+                    logger.info("Pruning stale fleet checkpoint %s", path)
+                    shutil.rmtree(path, ignore_errors=True)
+            except OSError:
+                continue

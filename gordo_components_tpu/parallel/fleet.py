@@ -316,7 +316,9 @@ class FleetTrainer:
                     self.seed,
                     int(mesh.shape[MODEL_AXIS]),
                 ],
-                data=Xs,  # content hash: same-shaped but different data must not resume
+                # content hash per member (streamed, pre-padding): same-shaped
+                # but different data must not resume
+                data=(arrays[n] for n in names),
             )
             ckpt = FleetBucketCheckpoint(self.checkpoint_dir, key)
             resumed = ckpt.restore()
@@ -339,6 +341,11 @@ class FleetTrainer:
                     patience = np.asarray(resumed["patience"], np.int64)
                     histories = [list(h) for h in resumed["histories"]]
                     start_epoch = int(resumed["epoch"]) + 1
+                    if es_enabled and not active.any():
+                        # every member already early-stopped when preempted
+                        # (during the post-loop scaler pass): skip the loop
+                        # entirely instead of running one no-op epoch
+                        start_epoch = self.epochs
                 except Exception:
                     # e.g. a library upgrade changed the opt-state pytree
                     # structure between preemption and restart: start fresh
