@@ -137,6 +137,7 @@ def build_fleet(
     replace_cache: bool = False,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
+    distributed: bool = False,
 ) -> Dict[str, str]:
     """Build every machine; returns name -> artifact dir.
 
@@ -149,6 +150,36 @@ def build_fleet(
     """
     results: Dict[str, str] = {}
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
+
+    if distributed:
+        # pod-scale gang: every host runs this same function; each owns a
+        # deterministic member slice and trains it independently — zero DCN
+        # traffic during training (parallel/distributed.py)
+        from gordo_components_tpu.parallel.distributed import (
+            initialize_distributed,
+            partition_members,
+        )
+
+        if not initialize_distributed():
+            # misconfigured rendezvous silently degrading would make EVERY
+            # worker own the full fleet: duplicated training + racing
+            # artifact writes. Be loud; proceed only because a genuine
+            # single-host launch with --distributed is legitimate.
+            logger.warning(
+                "--distributed requested but running single-process "
+                "(no coordinator found / rendezvous not configured): this "
+                "process will build ALL %d members. If other workers were "
+                "launched the same way they are duplicating this work.",
+                len(machines),
+            )
+        owned = set(partition_members([m.name for m in machines]))
+        skipped = [m.name for m in machines if m.name not in owned]
+        if skipped:
+            logger.info(
+                "Distributed gang: this host owns %d/%d members",
+                len(owned), len(machines),
+            )
+        machines = [m for m in machines if m.name in owned]
 
     for machine in machines:
         ae_kwargs = extract_fleetable(machine.model)

@@ -93,8 +93,11 @@ def build(name, model_config, data_config, metadata, output_dir, model_register_
 @click.option("--checkpoint-every", envvar="CHECKPOINT_EVERY", default=1, type=int,
               help="Epochs between fleet checkpoints (amortizes the "
                    "device-to-host state gather for large buckets)")
+@click.option("--distributed", is_flag=True, envvar="GORDO_DISTRIBUTED",
+              help="Multi-host gang: init jax.distributed and build only "
+                   "this host's member slice")
 def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_dir,
-                    checkpoint_every):
+                    checkpoint_every, distributed):
     """Build a gang of machines in one process (TPU fleet engine)."""
     from gordo_components_tpu.builder.fleet_build import build_fleet
     from gordo_components_tpu.workflow.config import Machine
@@ -124,6 +127,7 @@ def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_di
         results = build_fleet(
             machines, output_dir, model_register_dir=model_register_dir,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            distributed=distributed,
         )
     except Exception as exc:
         click.echo(f"Fleet build failed: {exc}", err=True)

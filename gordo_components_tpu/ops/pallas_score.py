@@ -16,6 +16,14 @@ kernel logic on CPU). Feature/row padding to hardware tiles (8 sublanes x
 128 lanes for f32) happens in the wrapper; padded feature lanes are masked
 inside the kernel so they contribute nothing to the scaled errors or the
 norms.
+
+Scope: the kernel accelerates the *per-model* scoring path
+(``DiffBasedAnomalyDetector.anomaly`` — single model, one (rows, F)
+request). The banked serving path (server/bank.py) runs the same epilogue
+definition (``_jnp_score``) inside its vmapped per-bucket program, where
+XLA fuses it into the batched matmul; moving that under the kernel (a
+batched grid with per-model scaler gathers) is a possible follow-up once
+profiled.
 """
 
 import functools
@@ -64,7 +72,11 @@ def _pallas_score(target, output, shift, scale, interpret=False):
 
     rows, F = target.shape
     Fp = -(-F // LANE) * LANE
-    Rp = -(-rows // ROW_TILE) * ROW_TILE
+    # adaptive row tile: small requests shouldn't pad to a full ROW_TILE
+    # (a 33-row request tiles at 40, not 256); multiples of the 8-sublane
+    # f32 tile keep the hardware layout happy
+    row_tile = min(ROW_TILE, -(-rows // 8) * 8)
+    Rp = -(-rows // row_tile) * row_tile
 
     pad2 = lambda a: jnp.pad(a, ((0, Rp - rows), (0, Fp - F)))
     t = pad2(target.astype(jnp.float32))
@@ -72,9 +84,9 @@ def _pallas_score(target, output, shift, scale, interpret=False):
     row_vec = lambda v: jnp.pad(v.astype(jnp.float32), (0, Fp - F))[None, :]
     sh, sc = row_vec(shift), row_vec(scale)
 
-    grid = (Rp // ROW_TILE,)
+    grid = (Rp // row_tile,)
     tile = lambda: pl.BlockSpec(
-        (ROW_TILE, Fp), lambda i: (i, 0), memory_space=pltpu.VMEM
+        (row_tile, Fp), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     const = lambda: pl.BlockSpec((1, Fp), lambda i: (0, 0), memory_space=pltpu.VMEM)
 
@@ -85,8 +97,8 @@ def _pallas_score(target, output, shift, scale, interpret=False):
         out_specs=[
             tile(),
             tile(),
-            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Rp, Fp), jnp.float32),
@@ -143,6 +155,10 @@ def fused_anomaly_score(
         return _pallas_score(target, output, shift, scale, interpret=True)
     try:
         out = _pallas_score(target, output, shift, scale)
+        # async dispatch: execution errors surface at result consumption,
+        # which would be outside this try — block here so runtime failures
+        # (e.g. allocation) are caught and can fall back per call
+        jax.block_until_ready(out)
         _pallas_ever_worked = True
         return out
     except Exception:

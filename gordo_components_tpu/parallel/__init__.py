@@ -11,5 +11,18 @@ compiler, not the cluster scheduler.
 
 from gordo_components_tpu.parallel.mesh import fleet_mesh, shard_model_axis
 from gordo_components_tpu.parallel.fleet import FleetTrainer, FleetMemberModel
+from gordo_components_tpu.parallel.distributed import (
+    initialize_distributed,
+    partition_members,
+    process_member_slice,
+)
 
-__all__ = ["fleet_mesh", "shard_model_axis", "FleetTrainer", "FleetMemberModel"]
+__all__ = [
+    "fleet_mesh",
+    "shard_model_axis",
+    "FleetTrainer",
+    "FleetMemberModel",
+    "initialize_distributed",
+    "partition_members",
+    "process_member_slice",
+]

@@ -30,6 +30,7 @@ import hashlib
 import json
 import logging
 import os
+import re
 import shutil
 from typing import Any, Dict, Optional
 
@@ -37,6 +38,8 @@ import jax
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+_KEY_RE = re.compile(r"[0-9a-f]{24}")
 
 
 def bucket_checkpoint_key(payload: Any, data=None) -> str:
@@ -151,7 +154,16 @@ class FleetBucketCheckpoint:
         for entry in os.listdir(parent):
             path = os.path.join(parent, entry)
             try:
-                if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+                # only touch directories that are unmistakably our
+                # checkpoints (24-hex key containing integer epoch dirs) —
+                # checkpoint_dir may be a shared volume with other data
+                if not (
+                    os.path.isdir(path)
+                    and _KEY_RE.fullmatch(entry)
+                    and all(e.isdigit() for e in os.listdir(path))
+                ):
+                    continue
+                if os.path.getmtime(path) < cutoff:
                     logger.info("Pruning stale fleet checkpoint %s", path)
                     shutil.rmtree(path, ignore_errors=True)
             except OSError:

@@ -1,0 +1,126 @@
+"""Multi-host bootstrap: the DCN-spanning runtime for pod-scale gangs.
+
+The reference's "distributed runtime" is the Kubernetes scheduler — one
+pod per model, no collective backend at all (SURVEY.md §2 "Distributed
+communication backend"). The TPU-native equivalent has two layers:
+
+- **within a slice**: XLA collectives over ICI, already used by the fleet
+  engine's model-axis sharding and the DP step (parallel/dp.py) — nothing
+  to bootstrap, ``jax.devices()`` covers the slice.
+- **across hosts of a pod (DCN)**: JAX's multi-controller runtime.
+  Every host runs the same gang program; :func:`initialize_distributed`
+  wires them into one JAX process group so ``jax.devices()`` spans the
+  pod and a ``Mesh`` over it lays the fleet's model axis across every
+  chip. On TPU pod slices JAX autodetects coordinator/process topology
+  from the TPU metadata; elsewhere (CPU test rigs, GKE indexed jobs) the
+  ``GORDO_*`` env vars or kwargs supply it.
+
+For the many-model fleet the cheapest pod-scale strategy is *host data
+ownership*: each host loads and trains only its member slice
+(:func:`process_member_slice`) — zero DCN traffic during training, exactly
+the property that made the reference's pod-per-model design scale, kept
+here at 1/N the process count.
+"""
+
+import logging
+import os
+from typing import List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize JAX's multi-controller runtime (idempotent).
+
+    Resolution order per field: explicit kwarg -> ``GORDO_COORDINATOR`` /
+    ``GORDO_NUM_PROCESSES`` / ``GORDO_PROCESS_ID`` env -> JAX autodetection
+    (TPU pod metadata). Returns True when part of a multi-process group,
+    False when single-process (no coordinator configured anywhere).
+    """
+    global _initialized
+    import jax
+
+    # NB: jax.process_count()/jax.devices() would initialize the XLA
+    # backend, after which jax.distributed.initialize() refuses to run —
+    # only is_initialized() is safe to probe here.
+    if _initialized or jax.distributed.is_initialized():
+        _initialized = True
+        return jax.process_count() > 1
+
+    coordinator_address = coordinator_address or os.environ.get("GORDO_COORDINATOR")
+    env_np = os.environ.get("GORDO_NUM_PROCESSES")
+    env_pid = os.environ.get("GORDO_PROCESS_ID")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+
+    if coordinator_address is None and num_processes is None:
+        # On TPU pods jax.distributed.initialize() autodetects; calling it
+        # on a single-host/CPU rig raises — treat that as single-process.
+        try:
+            jax.distributed.initialize()
+            _initialized = True
+            return jax.process_count() > 1
+        except Exception:
+            logger.debug("No distributed environment detected; single-process")
+            return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "Distributed runtime up: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return jax.process_count() > 1
+
+
+def process_member_slice(
+    n_members: int,
+    process_id: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Contiguous ``[start, stop)`` member range owned by this host.
+
+    Balanced to within one member: the first ``n_members % P`` processes
+    take one extra. Defaults to the live JAX process topology.
+    """
+    if process_id is None or process_count is None:
+        import jax
+
+        process_id = jax.process_index() if process_id is None else process_id
+        process_count = (
+            jax.process_count() if process_count is None else process_count
+        )
+    if not 0 <= process_id < process_count:
+        raise ValueError(f"process_id {process_id} not in [0, {process_count})")
+    base, extra = divmod(n_members, process_count)
+    start = process_id * base + min(process_id, extra)
+    stop = start + base + (1 if process_id < extra else 0)
+    return start, stop
+
+
+def partition_members(
+    names: Sequence[str],
+    process_id: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> List[str]:
+    """The member names this host owns (sorted first, so every host
+    computes the same global order without communicating)."""
+    ordered = sorted(names)
+    start, stop = process_member_slice(len(ordered), process_id, process_count)
+    return ordered[start:stop]

@@ -185,15 +185,18 @@ class _Bucket:
 
         def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
             # idx: (B,) int32; X/Y: (B, T, F) raw-space
+            from gordo_components_tpu.ops.pallas_score import _jnp_score
+
             def one(i, x, y):
                 p = jax.tree.map(lambda a: a[i], params)
                 xs = (x - in_shift[i]) * in_scale[i]
                 ys = (y - in_shift[i]) * in_scale[i]
                 recon = module.apply(p, xs)
-                diff = jnp.abs(ys - recon)
-                scaled = (diff - err_shift[i]) * err_scale[i]
-                tot_u = jnp.linalg.norm(diff, axis=-1)
-                tot_s = jnp.linalg.norm(scaled, axis=-1)
+                # same epilogue definition as the per-model path (XLA fuses
+                # it into the batched program here; see ops/pallas_score.py)
+                diff, scaled, tot_u, tot_s = _jnp_score(
+                    ys, recon, err_shift[i], err_scale[i]
+                )
                 return recon, diff, scaled, tot_u, tot_s
 
             return jax.vmap(one)(idx, X, Y)
