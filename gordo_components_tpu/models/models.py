@@ -26,7 +26,6 @@ from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.models import factories  # noqa: F401 — registers factories
 from gordo_components_tpu.models import train_core
 from gordo_components_tpu.ops.losses import explained_variance
-from gordo_components_tpu.ops.windows import sliding_windows
 from gordo_components_tpu.utils import capture_args
 
 logger = logging.getLogger(__name__)
@@ -271,7 +270,12 @@ class SequenceBaseEstimator(BaseEstimator):
                 f"Need at least lookback_window+{self._target_offset}="
                 f"{lb + self._target_offset} rows, got {X.shape[0]}"
             )
-        W = np.asarray(sliding_windows(jnp.asarray(X), lb))
+        # host-side windowing: native multithreaded copy when available
+        # (gordo_components_tpu/native); ops/windows.sliding_windows is the
+        # in-graph equivalent used inside jit'd programs
+        from gordo_components_tpu.native import sliding_windows_host
+
+        W = sliding_windows_host(X, lb)
         if self._target_offset:
             W = W[: -self._target_offset]
         return W

@@ -1,0 +1,62 @@
+// Native host-side data-pipeline ops for the fleet engine.
+//
+// The reference's host hot loop is per-tag IO + pandas joins inside one
+// builder pod (SURVEY.md §3.1). The TPU-native fleet engine replaces the
+// per-pod loop with one process feeding a whole model bank, which moves the
+// bottleneck to host-side staging: stacking/padding thousands of ragged
+// member arrays into the (M, rows, features) device layout, and
+// materializing lookback windows for sequence models. Both are pure
+// memcpy-shaped loops — this library runs them multithreaded (OpenMP) in
+// C++ instead of a Python for-loop, with gordo_components_tpu/native/
+// __init__.py falling back to numpy when no toolchain is available.
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC hostops.cpp
+// ABI: plain C, int64 sizes, float32 row-major buffers (numpy defaults).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Stack n_members ragged (rows[i], n_features) arrays into a padded
+// (M, padded_rows, n_features) block plus an (M, padded_rows) sample mask.
+// Slots i >= n_members replicate member i % n_members (mesh-padding
+// dummies, exactly like the Python path). out_x/out_mask must be
+// zero-initialized by the caller (calloc'd numpy arrays).
+void fleet_stack_pad(const float** members,
+                     const int64_t* rows,
+                     int64_t n_members,
+                     int64_t M,
+                     int64_t padded_rows,
+                     int64_t n_features,
+                     float* out_x,
+                     float* out_mask) {
+#pragma omp parallel for schedule(dynamic)
+  for (int64_t i = 0; i < M; ++i) {
+    const int64_t src = i % n_members;
+    const int64_t r = rows[src];
+    std::memcpy(out_x + i * padded_rows * n_features,
+                members[src],
+                sizeof(float) * static_cast<size_t>(r) * n_features);
+    float* mask_row = out_mask + i * padded_rows;
+    for (int64_t j = 0; j < r; ++j) mask_row[j] = 1.0f;
+  }
+}
+
+// (rows, f) -> (rows - lookback + 1, lookback, f) sliding windows.
+void sliding_windows_f32(const float* x,
+                         int64_t rows,
+                         int64_t f,
+                         int64_t lookback,
+                         float* out) {
+  const int64_t nw = rows - lookback + 1;
+  if (nw <= 0) return;
+#pragma omp parallel for schedule(static)
+  for (int64_t w = 0; w < nw; ++w) {
+    std::memcpy(out + w * lookback * f,
+                x + w * f,
+                sizeof(float) * static_cast<size_t>(lookback) * f);
+  }
+}
+
+}  // extern "C"

@@ -211,13 +211,14 @@ class FleetTrainer:
         M = pad_count_to_mesh(M_real, mesh)
         bs = self.batch_size
 
-        # ---- stack + pad host-side (the one unavoidable host loop) ----
-        Xs = np.zeros((M, padded_rows, n_features), dtype=np.float32)
-        masks = np.zeros((M, padded_rows), dtype=np.float32)
-        for i in range(M):
-            X = arrays[names[i % M_real]]  # dummies replicate real members
-            Xs[i, : X.shape[0]] = X
-            masks[i, : X.shape[0]] = 1.0
+        # ---- stack + pad host-side (the one unavoidable host loop;
+        # multithreaded C++ when the native lib is available, with dummies
+        # replicating real members for mesh padding either way) ----
+        from gordo_components_tpu.native import fleet_stack_pad
+
+        Xs, masks = fleet_stack_pad(
+            [arrays[n] for n in names], M, padded_rows, n_features
+        )
 
         sharding = shard_model_axis(mesh)
         Xd = jax.device_put(jnp.asarray(Xs), sharding)
