@@ -24,6 +24,7 @@ from gordo_components_tpu import __version__
 from gordo_components_tpu.dataset import get_dataset
 from gordo_components_tpu import serializer
 from gordo_components_tpu.utils import metadata_timestamp
+from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
 
 logger = logging.getLogger(__name__)
 
@@ -60,7 +61,8 @@ def build_model(
     t1 = time.time()
     trained = False
     if evaluation_config["cv_mode"] != "cross_val_only":
-        model.fit(X, y)
+        with maybe_profile(f"build-{name}"):
+            model.fit(X, y)
         trained = True
     fit_elapsed = time.time() - t1
 
@@ -74,6 +76,7 @@ def build_model(
             "data_query_duration_sec": data_elapsed,
             "model_training_duration_sec": fit_elapsed,
             "trained": trained,
+            "device_memory": device_memory_stats(),
             **(model.get_metadata() if hasattr(model, "get_metadata") else _pipeline_metadata(model)),
         },
         "user-defined": metadata,

@@ -252,8 +252,12 @@ def _build_fleet_group(
         **trainer_kwargs, **ae_kwargs,
     )
     t1 = time.time()
-    fleet_models = trainer.fit(member_data)
+    from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
+
+    with maybe_profile(f"fleet-gang-{len(pending)}m"):
+        fleet_models = trainer.fit(member_data)
     train_elapsed = time.time() - t1
+    trainer.last_stats["device_memory"] = device_memory_stats()
 
     by_name = {m.name: m for m in pending}
     for name, fm in fleet_models.items():

@@ -25,13 +25,18 @@ EXIT_BUILD_ERROR = 83
 
 @click.group("gordo-components-tpu")
 @click.option("--log-level", default="INFO", envvar="LOG_LEVEL")
-def gordo(log_level):
+@click.option("--profile-dir", default=None, envvar="GORDO_PROFILE_DIR",
+              help="Write jax.profiler traces of train/build hot sections "
+                   "here (TensorBoard/Perfetto-viewable)")
+def gordo(log_level, profile_dir):
     """TPU-native gordo: build, serve, and orchestrate fleets of
     time-series anomaly-detection models."""
     logging.basicConfig(
         level=getattr(logging, log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if profile_dir:
+        os.environ["GORDO_PROFILE_DIR"] = profile_dir
 
 
 def _load_json_or_yaml(value: str):

@@ -201,16 +201,32 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+# jit cache for batched_apply: a fresh @jax.jit closure per call would
+# recompile on EVERY predict (the server's per-model hot path). Keyed by
+# module identity (modules are rebuilt once per estimator and reused) and
+# batch size; the module object is pinned in the value so its id can't be
+# recycled while the entry lives.
+_apply_cache: dict = {}
+
+
 def _scan_apply(module, params, X_pad, batch_size):
-    @jax.jit
-    def run(params, X_pad):
-        n_batches = X_pad.shape[0] // batch_size
-        Xs = X_pad.reshape((n_batches, batch_size) + X_pad.shape[1:])
+    key = (id(module), batch_size)
+    entry = _apply_cache.get(key)
+    if entry is None or entry[0] is not module:
 
-        def step(_, xb):
-            return None, module.apply(params, xb)
+        @jax.jit
+        def run(params, X_pad):
+            n_batches = X_pad.shape[0] // batch_size
+            Xs = X_pad.reshape((n_batches, batch_size) + X_pad.shape[1:])
 
-        _, out = jax.lax.scan(step, None, Xs)
-        return out.reshape((n_batches * batch_size,) + out.shape[2:])
+            def step(_, xb):
+                return None, module.apply(params, xb)
 
-    return run(params, X_pad)
+            _, out = jax.lax.scan(step, None, Xs)
+            return out.reshape((n_batches * batch_size,) + out.shape[2:])
+
+        if len(_apply_cache) >= 512:  # bound memory on pathological churn
+            _apply_cache.clear()
+        entry = (module, run)
+        _apply_cache[key] = entry
+    return entry[1](params, X_pad)

@@ -135,20 +135,21 @@ def fleet_stack_pad(
     n = len(members)
     if n == 0:
         raise ValueError("No members to stack")
+    cmembers = [_as_c_f32(m) for m in members]
+    # validate on BOTH paths — the fallback must reject exactly what the
+    # native code rejects, never silently broadcast a malformed member
+    for m in cmembers:
+        if m.ndim != 2 or m.shape[1] != n_features or m.shape[0] > padded_rows:
+            raise ValueError(f"Bad member shape {m.shape} for ({padded_rows}, {n_features})")
     lib = get_lib() if _use_native() else None
     if lib is None:
         Xs = np.zeros((M, padded_rows, n_features), dtype=np.float32)
         mask = np.zeros((M, padded_rows), dtype=np.float32)
         for i in range(M):
-            X = members[i % n]
+            X = cmembers[i % n]
             Xs[i, : X.shape[0]] = X
             mask[i, : X.shape[0]] = 1.0
         return Xs, mask
-
-    cmembers = [_as_c_f32(m) for m in members]
-    for m in cmembers:
-        if m.ndim != 2 or m.shape[1] != n_features or m.shape[0] > padded_rows:
-            raise ValueError(f"Bad member shape {m.shape} for ({padded_rows}, {n_features})")
     Xs = np.zeros((M, padded_rows, n_features), dtype=np.float32)
     mask = np.zeros((M, padded_rows), dtype=np.float32)
     fp = ctypes.POINTER(ctypes.c_float)

@@ -180,7 +180,9 @@ class FleetTrainer:
         bucket_stats = []
         for (n_features, padded_rows), names in sorted(buckets.items()):
             tb = time.time()
-            res = self._fit_bucket(n_features, padded_rows, names, arrays)
+            res, epoch_seconds = self._fit_bucket(
+                n_features, padded_rows, names, arrays
+            )
             out.update(res)
             bucket_stats.append(
                 {
@@ -188,6 +190,9 @@ class FleetTrainer:
                     "padded_rows": padded_rows,
                     "n_members": len(names),
                     "seconds": time.time() - tb,
+                    # structured per-epoch timing: epoch 0 includes the XLA
+                    # compile, steady-state is the rest
+                    "epoch_seconds": epoch_seconds,
                 }
             )
         self.last_stats = {
@@ -205,7 +210,7 @@ class FleetTrainer:
         padded_rows: int,
         names: List[str],
         arrays: Dict[str, np.ndarray],
-    ) -> Dict[str, FleetMemberModel]:
+    ) -> Tuple[Dict[str, FleetMemberModel], List[float]]:
         mesh = self.mesh if self.mesh is not None else fleet_mesh()
         M_real = len(names)
         M = pad_count_to_mesh(M_real, mesh)
@@ -387,9 +392,12 @@ class FleetTrainer:
                 },
             )
 
+        epoch_times: List[float] = []
         for epoch in range(start_epoch, self.epochs):
+            te = time.time()
             states, losses = run_epoch(states, Xd, maskd, jnp.asarray(active))
             losses = np.asarray(losses)
+            epoch_times.append(time.time() - te)
             for i in range(M):
                 if active[i] > 0:
                     histories[i].append(float(losses[i]))
@@ -490,4 +498,4 @@ class FleetTrainer:
         # last epoch checkpoint instead of retraining from scratch
         if ckpt is not None:
             ckpt.clear()
-        return out
+        return out, [round(t, 4) for t in epoch_times]

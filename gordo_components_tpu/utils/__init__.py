@@ -8,5 +8,12 @@ be round-tripped through metadata / config definitions.
 
 from gordo_components_tpu.utils.capture import capture_args
 from gordo_components_tpu.utils.metadata import metadata_timestamp, package_version
+from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
 
-__all__ = ["capture_args", "metadata_timestamp", "package_version"]
+__all__ = [
+    "capture_args",
+    "metadata_timestamp",
+    "package_version",
+    "device_memory_stats",
+    "maybe_profile",
+]

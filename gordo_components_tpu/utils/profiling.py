@@ -1,0 +1,71 @@
+"""On-demand profiling and device observability.
+
+The reference's only timing artifact is the per-epoch Keras history
+captured into build metadata (SURVEY.md §5 "Tracing / profiling"). The
+TPU-native rebuild keeps that metadata-as-contract design and adds what a
+compiled-accelerator stack actually needs:
+
+- :func:`maybe_profile` — a ``jax.profiler`` trace (viewable in
+  TensorBoard / Perfetto) around any block, activated by passing a
+  directory or exporting ``GORDO_PROFILE_DIR``; zero overhead when off.
+- :func:`device_memory_stats` — per-device HBM usage snapshot, recorded
+  into build metadata so fleet sizing (models per chip) is observable from
+  the artifact, not just from a live process.
+"""
+
+import contextlib
+import logging
+import os
+import re
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def maybe_profile(name: str, profile_dir: Optional[str] = None):
+    """Trace the enclosed block when profiling is enabled.
+
+    ``profile_dir`` falls back to env ``GORDO_PROFILE_DIR``; when neither
+    is set the context is free. Traces land under
+    ``<profile_dir>/<name>/`` (name is sanitized for the filesystem).
+    """
+    profile_dir = profile_dir or os.environ.get("GORDO_PROFILE_DIR")
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    safe = re.sub(r"[^A-Za-z0-9_.-]+", "-", name) or "trace"
+    out = os.path.join(profile_dir, safe)
+    os.makedirs(out, exist_ok=True)
+    logger.info("Profiling %r -> %s", name, out)
+    with jax.profiler.trace(out):
+        yield
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    """Per-device memory snapshot: ``{device: {bytes_in_use, bytes_limit,
+    peak_bytes_in_use}}`` for devices that report stats (TPU does; CPU
+    returns an empty dict)."""
+    import jax
+
+    out: Dict[str, Any] = {}
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return out
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        out[str(d)] = {
+            k: int(stats[k])
+            for k in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
+            if k in stats
+        }
+    return out

@@ -41,16 +41,23 @@ def test_fleet_stack_pad_matches_numpy():
     np.testing.assert_array_equal(got_m, exp_m)
 
 
-def test_fleet_stack_pad_validates():
+@pytest.mark.parametrize("use_native", [True, False])
+def test_fleet_stack_pad_validates(monkeypatch, use_native):
+    """Both paths must reject the same malformed inputs — the fallback
+    may never silently broadcast what the native code refuses."""
+    if not use_native:
+        monkeypatch.setattr(native, "get_lib", lambda: None)
     with pytest.raises(ValueError):
         native.fleet_stack_pad([], 4, 10, 3)
-    if native.native_available():
-        with pytest.raises(ValueError):
-            # member wider than n_features
-            native.fleet_stack_pad([np.zeros((5, 4), np.float32)], 2, 10, 3)
-        with pytest.raises(ValueError):
-            # member longer than padded_rows
-            native.fleet_stack_pad([np.zeros((11, 3), np.float32)], 2, 10, 3)
+    with pytest.raises(ValueError):
+        # member wider than n_features
+        native.fleet_stack_pad([np.zeros((5, 4), np.float32)], 2, 10, 3)
+    with pytest.raises(ValueError):
+        # member longer than padded_rows
+        native.fleet_stack_pad([np.zeros((11, 3), np.float32)], 2, 10, 3)
+    with pytest.raises(ValueError):
+        # 1-D member
+        native.fleet_stack_pad([np.zeros(3, np.float32)], 2, 10, 3)
 
 
 def test_sliding_windows_matches_reference():

@@ -1,0 +1,70 @@
+"""Profiling/observability tests: traces only when enabled, memory stats
+shape, and the structured timing that now lands in build metadata."""
+
+import numpy as np
+
+from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
+
+
+def test_maybe_profile_off_is_free(monkeypatch):
+    monkeypatch.delenv("GORDO_PROFILE_DIR", raising=False)
+    with maybe_profile("noop"):
+        pass  # no jax import, no trace dir
+
+
+def test_maybe_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with maybe_profile("unit trace/x", profile_dir=str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    # sanitized name, non-empty trace directory
+    out = tmp_path / "unit-trace-x"
+    assert out.is_dir()
+    assert any(out.rglob("*")), "profiler should have written trace files"
+
+
+def test_maybe_profile_env_activation(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("GORDO_PROFILE_DIR", str(tmp_path))
+    with maybe_profile("envtrace"):
+        jnp.ones((4,)).sum().block_until_ready()
+    assert (tmp_path / "envtrace").is_dir()
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    # CPU backends may report nothing; whatever is reported must be ints
+    for dev, s in stats.items():
+        assert isinstance(dev, str)
+        for v in s.values():
+            assert isinstance(v, int)
+
+
+def test_fleet_stats_include_epoch_seconds():
+    from gordo_components_tpu.parallel.fleet import FleetTrainer
+
+    rng = np.random.RandomState(0)
+    members = {f"m-{i}": rng.rand(40, 3).astype("float32") for i in range(2)}
+    trainer = FleetTrainer(epochs=3, batch_size=20)
+    trainer.fit(members)
+    (bucket,) = trainer.last_stats["buckets"]
+    assert len(bucket["epoch_seconds"]) == 3
+    assert all(t >= 0 for t in bucket["epoch_seconds"])
+
+
+def test_build_metadata_has_device_memory(tmp_path):
+    from gordo_components_tpu.builder import build_model
+
+    _, meta = build_model(
+        "prof-m",
+        {"gordo_components_tpu.models.AutoEncoder": {"epochs": 1, "batch_size": 32}},
+        {
+            "type": "RandomDataset",
+            "train_start_date": "2020-01-01T00:00:00Z",
+            "train_end_date": "2020-01-01T04:00:00Z",
+            "tag_list": ["a", "b"],
+        },
+    )
+    assert "device_memory" in meta["model"]
