@@ -22,6 +22,7 @@ Results unstack into ordinary estimator objects (``FleetMemberModel`` →
 and the client treat fleet-trained models identically to single builds.
 """
 
+import functools
 import logging
 import time
 from dataclasses import dataclass, field
@@ -47,6 +48,141 @@ from gordo_components_tpu.parallel.mesh import (
 from gordo_components_tpu.utils import capture_args
 
 logger = logging.getLogger(__name__)
+
+
+# ---- per-bucket jit'd programs, cached process-wide -------------------- #
+# A fresh fit() must not retrace/recompile programs an earlier fit already
+# built for the same (architecture, optimizer config, batch size): repeated
+# builds (warmup -> bench, build-cache reruns, server-side refits) hit the
+# jit cache through these shared function objects. Flax modules are frozen
+# dataclasses, so equal-config modules hash equal and share an entry.
+
+
+@jax.jit
+def _fit_scalers(X, mask):
+    Xn = jnp.where(mask[..., None] > 0, X, jnp.nan)
+    return jax.vmap(fit_minmax)(Xn)
+
+
+@jax.jit
+def _transform_all(scalers, X):
+    return jax.vmap(scaler_transform)(scalers, X)
+
+
+def _select_improved(improved, best_tree, new_tree):
+    """Per-model select: where ``improved`` (M,) is set, take the new
+    leaves; else keep the best-so-far. Shared by the per-epoch host loop
+    and the on-device chunk body so the two ES engines cannot diverge."""
+
+    def sel(b, n):
+        shape = (-1,) + (1,) * (n.ndim - 1)
+        return jnp.where(improved.reshape(shape) > 0, n, b)
+
+    return jax.tree.map(sel, best_tree, new_tree)
+
+
+@jax.jit
+def _merge_best(best_p, new_p, improved):
+    return _select_improved(improved, best_p, new_p)
+
+
+class _BucketPrograms:
+    """All compiled programs for one (module, optimizer, batch-size) key."""
+
+    def __init__(self, module, opt_name: str, lr: float, batch_size: int):
+        self.module = module
+        optimizer = train_core.make_optimizer(opt_name, lr)
+        init_fn, epoch_fn = train_core.make_train_fns(module, optimizer, batch_size)
+        self.init_stacked = jax.jit(jax.vmap(init_fn))
+
+        def masked_epoch(state, X, mask, active):
+            new_state, loss = epoch_fn(state, X, X, mask)
+            merged = jax.tree.map(
+                lambda n, o: jnp.where(active > 0, n, o), new_state, state
+            )
+            return merged, jnp.where(active > 0, loss, jnp.nan)
+
+        self._vm_epoch = jax.vmap(masked_epoch)
+        self.run_epoch = jax.jit(jax.vmap(masked_epoch), donate_argnums=(0,))
+
+        @jax.jit
+        def fit_error_scalers(params, X, mask):
+            def one(p, x, m):
+                pred = module.apply(p, x)
+                diff = jnp.abs(x - pred)
+                diff = jnp.where(m[..., None] > 0, diff, jnp.nan)
+                es = fit_minmax(diff)
+                scaled = scaler_transform(es, diff)
+                feat_thresh = jnp.nanmax(scaled, axis=0)
+                total = jnp.sqrt(jnp.nansum(scaled**2, axis=-1))
+                total = jnp.where(m > 0, total, jnp.nan)
+                return es, feat_thresh, jnp.nanmax(total)
+
+            return jax.vmap(one)(params, X, mask)
+
+        self.fit_error_scalers = fit_error_scalers
+        self._chunks: Dict[Tuple, Any] = {}
+
+    def chunk_fn(self, K: int, es_enabled: bool, es_p0, delta):
+        """K-epoch device chunk with (optional) on-device early stopping."""
+        key = (K, es_enabled, int(es_p0), float(delta))
+        if key not in self._chunks:
+            vm_epoch = self._vm_epoch
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run_chunk(carry, X, mask):
+                # body closes over run_chunk's traced X/mask args — NOT
+                # outer device arrays, which jit would bake in as constants.
+                # Each epoch emits (loss, pre-epoch active) so the host can
+                # tell "was inactive" apart from "active but NaN loss".
+                if es_enabled:
+
+                    def body(c, _):
+                        st, act, bst, pat, bp = c
+                        act_pre = act
+                        st2, losses = vm_epoch(st, X, mask, act)
+                        improved = (losses < bst - delta) & (act > 0)
+                        bst = jnp.where(improved, losses, bst)
+                        bp = _select_improved(
+                            improved.astype(jnp.float32), bp, st2.params
+                        )
+                        pat = jnp.where(
+                            improved,
+                            jnp.int32(es_p0),
+                            pat - (act > 0).astype(jnp.int32),
+                        )
+                        act = jnp.where(
+                            (pat <= 0) & ~improved, 0.0, act
+                        ).astype(jnp.float32)
+                        return (st2, act, bst, pat, bp), (losses, act_pre)
+
+                else:
+
+                    def body(c, _):
+                        st, act, bst, pat = c
+                        st2, losses = vm_epoch(st, X, mask, act)
+                        return (st2, act, bst, pat), (losses, act)
+
+                return jax.lax.scan(body, carry, None, length=K)
+
+            self._chunks[key] = run_chunk
+        return self._chunks[key]
+
+
+_PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
+
+
+def _bucket_programs(module, opt_name: str, lr: float, batch_size: int) -> _BucketPrograms:
+    key = (module, opt_name, float(lr), int(batch_size))
+    try:
+        prog = _PROGRAM_CACHE.get(key)
+    except TypeError:  # unhashable factory kwargs: build uncached
+        return _BucketPrograms(module, opt_name, lr, batch_size)
+    if prog is None:
+        if len(_PROGRAM_CACHE) >= 128:  # bound on pathological churn
+            _PROGRAM_CACHE.clear()
+        prog = _PROGRAM_CACHE[key] = _BucketPrograms(module, opt_name, lr, batch_size)
+    return prog
 
 
 @dataclass
@@ -130,6 +266,7 @@ class FleetTrainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         epoch_callback=None,
+        host_sync_every: int = 1,
         **factory_kwargs,
     ):
         self.kind = kind
@@ -148,7 +285,15 @@ class FleetTrainer:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = max(1, int(checkpoint_every))
         # epoch_callback(info_dict) after every epoch: progress/metrics hook
+        # (with host_sync_every > 1, called once per chunk with the chunk's
+        # last epoch)
         self.epoch_callback = epoch_callback
+        # >1 = bounded-epoch chunks: K epochs per XLA dispatch with early
+        # stopping evaluated on device; the host syncs once per chunk.
+        # Early-stopped models may run up to K-1 extra (masked) epochs and
+        # ES comparisons run in f32 instead of f64 — throughput for exact
+        # per-epoch host control (SURVEY.md §7 hard part 4).
+        self.host_sync_every = int(host_sync_every)
         self.factory_kwargs = factory_kwargs
         self.last_stats: Dict[str, Any] = {}
 
@@ -231,45 +376,27 @@ class FleetTrainer:
 
         # ---- per-member scalers, fitted on device (masked rows excluded
         # by writing NaNs, which the nan-aware fit ignores) ----
-        @jax.jit
-        def fit_scalers(X, mask):
-            Xn = jnp.where(mask[..., None] > 0, X, jnp.nan)
-            return jax.vmap(fit_minmax)(Xn)
-
-        scalers = fit_scalers(Xd, maskd)
-
-        @jax.jit
-        def transform_all(scalers, X):
-            return jax.vmap(scaler_transform)(scalers, X)
-
-        Xd = transform_all(scalers, Xd)
+        scalers = _fit_scalers(Xd, maskd)
+        Xd = _transform_all(scalers, Xd)
         # padded rows were NaN-protected during fit; re-zero them post-scale
         Xd = jnp.where(maskd[..., None] > 0, Xd, 0.0)
 
-        # ---- build module + stacked train state ----
+        # ---- build module + stacked train state (programs are cached
+        # process-wide per (module, optimizer, batch size)) ----
         factory = lookup_factory("AutoEncoder", self.kind)
         module = factory(
             n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
         )
-        optimizer = train_core.make_optimizer(self.optimizer, self.learning_rate)
-        init_fn, epoch_fn = train_core.make_train_fns(
-            module, optimizer, min(bs, padded_rows)
+        progs = _bucket_programs(
+            module, self.optimizer, self.learning_rate, min(bs, padded_rows)
         )
+        init_stacked = progs.init_stacked
+        run_epoch = progs.run_epoch
 
         rngs = jax.random.split(jax.random.PRNGKey(self.seed), M)
         sample = Xd[:, 0, :]  # (M, n_features)
-        init_stacked = jax.jit(jax.vmap(init_fn))
         states = init_stacked(rngs, sample)
         state_treedef = jax.tree.structure(states)
-
-        def masked_epoch(state, X, mask, active):
-            new_state, loss = epoch_fn(state, X, X, mask)
-            merged = jax.tree.map(
-                lambda n, o: jnp.where(active > 0, n, o), new_state, state
-            )
-            return merged, jnp.where(active > 0, loss, jnp.nan)
-
-        run_epoch = jax.jit(jax.vmap(masked_epoch), donate_argnums=(0,))
 
         # ---- epoch loop: device does the work; host only sees (M,) losses
         # and drives per-model early stopping ----
@@ -286,15 +413,6 @@ class FleetTrainer:
         # best-params restore, matching BaseEstimator.fit: each member ends
         # on the params of its best epoch, not the epoch it stopped at
         best_params = None
-        if es_enabled:
-
-            @jax.jit
-            def merge_best(best_p, new_p, improved):
-                def sel(b, n):
-                    shape = (-1,) + (1,) * (n.ndim - 1)
-                    return jnp.where(improved.reshape(shape) > 0, n, b)
-
-                return jax.tree.map(sel, best_p, new_p)
 
         # ---- preemption recovery: resume a matching interrupted run ----
         ckpt = None
@@ -321,6 +439,9 @@ class FleetTrainer:
                     self.early_stopping_min_delta,
                     self.seed,
                     int(mesh.shape[MODEL_AXIS]),
+                    # sync width changes the ES decision engine (device f32
+                    # vs host f64): a resume must not mix the two
+                    max(1, int(self.host_sync_every)),
                 ],
                 # content hash per member (streamed, pre-padding): same-shaped
                 # but different data must not resume
@@ -393,75 +514,120 @@ class FleetTrainer:
             )
 
         epoch_times: List[float] = []
-        for epoch in range(start_epoch, self.epochs):
-            te = time.time()
-            states, losses = run_epoch(states, Xd, maskd, jnp.asarray(active))
-            losses = np.asarray(losses)
-            epoch_times.append(time.time() - te)
-            for i in range(M):
-                if active[i] > 0:
-                    histories[i].append(float(losses[i]))
-            if es_enabled:
-                improved = (losses < best - self.early_stopping_min_delta) & (
-                    active > 0
-                )
-                best = np.where(improved, losses, best)
-                if best_params is None:
-                    best_params = jax.tree.map(jnp.copy, states.params)
-                else:
-                    best_params = merge_best(
-                        best_params, states.params, jnp.asarray(improved, jnp.float32)
-                    )
-                patience = np.where(
-                    improved, self.early_stopping_patience, patience - (active > 0)
-                )
-                # patience=0 parity with BaseEstimator.fit: a model stops only
-                # after a NON-improving epoch exhausts patience — an epoch
-                # that just improved (and reset patience to 0) keeps going.
-                active = np.where(
-                    (patience <= 0) & ~improved, 0.0, active
-                ).astype(np.float32)
+        sync = max(1, int(self.host_sync_every))
+
+        def after_epochs(first_epoch, losses_rows, active_rows):
+            """Host bookkeeping shared by both loop shapes: histories from
+            (k, M) loss rows + pre-epoch active rows (a model that was
+            active records its loss even if that loss is NaN — divergence
+            must stay visible in the history), callback, checkpoint."""
+            for row, act_row in zip(losses_rows, active_rows):
+                for i in range(M):
+                    if act_row[i] > 0:
+                        histories[i].append(float(row[i]))
+            last = first_epoch + len(losses_rows) - 1
             if self.epoch_callback is not None:
                 self.epoch_callback(
                     {
                         "n_features": n_features,
                         "padded_rows": padded_rows,
-                        "epoch": epoch,
-                        "losses": losses[: len(names)],
+                        "epoch": last,
+                        "losses": np.asarray(losses_rows[-1])[: len(names)],
                         "n_active": int((active > 0).sum()),
                     }
                 )
-            if (
-                ckpt is not None
-                and (epoch + 1) % self.checkpoint_every == 0
-                and epoch + 1 < self.epochs
-            ):
-                save_checkpoint(epoch)
-            if es_enabled and not active.any():
-                logger.info("All %d models early-stopped at epoch %d", M, epoch + 1)
-                break
+            crossed = (last + 1) // self.checkpoint_every > first_epoch // self.checkpoint_every
+            if ckpt is not None and crossed and last + 1 < self.epochs:
+                save_checkpoint(last)
+
+        if sync == 1:
+            for epoch in range(start_epoch, self.epochs):
+                te = time.time()
+                active_pre = active
+                states, losses = run_epoch(states, Xd, maskd, jnp.asarray(active))
+                losses = np.asarray(losses)
+                epoch_times.append(time.time() - te)
+                if es_enabled:
+                    improved = (losses < best - self.early_stopping_min_delta) & (
+                        active > 0
+                    )
+                    best = np.where(improved, losses, best)
+                    if best_params is None:
+                        best_params = jax.tree.map(jnp.copy, states.params)
+                    else:
+                        best_params = _merge_best(
+                            best_params, states.params,
+                            jnp.asarray(improved, jnp.float32),
+                        )
+                    patience = np.where(
+                        improved, self.early_stopping_patience, patience - (active > 0)
+                    )
+                    # patience=0 parity with BaseEstimator.fit: a model stops
+                    # only after a NON-improving epoch exhausts patience — an
+                    # epoch that just improved (patience reset) keeps going.
+                    after = np.where(
+                        (patience <= 0) & ~improved, 0.0, active
+                    ).astype(np.float32)
+                    active = after
+                after_epochs(epoch, [losses], [active_pre])
+                if es_enabled and not active.any():
+                    logger.info(
+                        "All %d models early-stopped at epoch %d", M, epoch + 1
+                    )
+                    break
+        else:
+            # ---- bounded-epoch chunks (SURVEY.md §7 hard part 4): K epochs
+            # per dispatch with early stopping evaluated ON DEVICE, so the
+            # host syncs once per chunk instead of once per epoch ----
+            es_p0 = int(self.early_stopping_patience if es_enabled else -1)
+            delta = float(self.early_stopping_min_delta)
+
+            def get_chunk_fn(K: int):
+                # carry WITHOUT best-params when ES is off: carrying an
+                # alias of st.params alongside st would break donation
+                return progs.chunk_fn(K, es_enabled, es_p0, delta)
+
+            if es_enabled and best_params is None:
+                best_params = jax.tree.map(jnp.copy, states.params)
+            carry = (
+                states,
+                jnp.asarray(active, jnp.float32),
+                jnp.asarray(best, jnp.float32),
+                jnp.asarray(patience, jnp.int32),
+            )
+            if es_enabled:
+                carry = carry + (best_params,)
+            epoch = start_epoch
+            while epoch < self.epochs:
+                K = min(sync, self.epochs - epoch)
+                te = time.time()
+                carry, (losses_k, act_k) = get_chunk_fn(K)(carry, Xd, maskd)
+                losses_k = np.asarray(losses_k)  # (K, M)
+                act_k = np.asarray(act_k)  # (K, M) pre-epoch active masks
+                chunk_t = time.time() - te
+                epoch_times.extend([round(chunk_t / K, 4)] * K)
+                # host snapshots for checkpoint/break bookkeeping
+                states = carry[0]
+                active = np.asarray(carry[1])
+                best = np.asarray(carry[2], np.float64)
+                patience = np.asarray(carry[3], np.int64)
+                if es_enabled:
+                    best_params = carry[4]
+                after_epochs(epoch, list(losses_k), list(act_k))
+                epoch += K
+                if es_enabled and not active.any():
+                    logger.info(
+                        "All %d models early-stopped by epoch %d", M, epoch
+                    )
+                    break
+            states = carry[0]
 
         final_params = best_params if best_params is not None else states.params
 
         # ---- error scalers + thresholds for the anomaly contract: one
         # vmapped pass (parity with DiffBasedAnomalyDetector.fit, which
         # records max scaled training error as the default threshold) ----
-        @jax.jit
-        def fit_error_scalers(params, X, mask):
-            def one(p, x, m):
-                pred = module.apply(p, x)
-                diff = jnp.abs(x - pred)
-                diff = jnp.where(m[..., None] > 0, diff, jnp.nan)
-                es = fit_minmax(diff)
-                scaled = scaler_transform(es, diff)
-                feat_thresh = jnp.nanmax(scaled, axis=0)
-                total = jnp.sqrt(jnp.nansum(scaled**2, axis=-1))
-                total = jnp.where(m > 0, total, jnp.nan)
-                return es, feat_thresh, jnp.nanmax(total)
-
-            return jax.vmap(one)(params, X, mask)
-
-        err_scalers, feat_thresh, total_thresh = fit_error_scalers(
+        err_scalers, feat_thresh, total_thresh = progs.fit_error_scalers(
             final_params, Xd, maskd
         )
         feat_thresh = np.asarray(feat_thresh)
