@@ -1,0 +1,36 @@
+# gordo-components-tpu build/test targets
+# (reference parity: the upstream Makefile's test/docker targets,
+# SURVEY.md §2 "packaging/CI" — adapted to the TPU-native stack)
+
+PYTHON ?= python
+IMAGE_PREFIX ?= gordo-components-tpu
+TAG ?= latest
+
+.PHONY: test test-fast bench images builder-image server-image watchman-image clean
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# skip the slowest integration suites for a quick signal
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x \
+		--ignore=tests/test_fleet_chunks.py \
+		--ignore=tests/test_checkpoint.py
+
+bench:
+	$(PYTHON) bench.py
+
+images: builder-image server-image watchman-image
+
+builder-image:
+	docker build -f Dockerfile-ModelBuilder -t $(IMAGE_PREFIX)/builder:$(TAG) .
+
+server-image:
+	docker build -f Dockerfile-ModelServer -t $(IMAGE_PREFIX)/server:$(TAG) .
+
+watchman-image:
+	docker build -f Dockerfile-Watchman -t $(IMAGE_PREFIX)/watchman:$(TAG) .
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

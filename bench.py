@@ -39,7 +39,8 @@ def bench_fleet(
     host_sync_every=5,
 ):
     """Many-model fleet training: models/hour/chip. ``host_sync_every``
-    runs the whole epoch budget as one on-device chunk (one dispatch)."""
+    is the on-device chunk size; with the defaults (epochs=5, chunk=5) the
+    whole epoch budget is one dispatch."""
     import jax
 
     from gordo_components_tpu.parallel import FleetTrainer

@@ -125,7 +125,11 @@ class _BucketPrograms:
 
     def chunk_fn(self, K: int, es_enabled: bool, es_p0, delta):
         """K-epoch device chunk with (optional) on-device early stopping."""
-        key = (K, es_enabled, int(es_p0), float(delta))
+        # ES-off programs ignore p0/delta: normalize them out of the key
+        # so trainers differing only in unused ES knobs share the compile
+        key = (
+            (K, True, int(es_p0), float(delta)) if es_enabled else (K, False, 0, 0.0)
+        )
         if key not in self._chunks:
             vm_epoch = self._vm_epoch
 
@@ -138,14 +142,19 @@ class _BucketPrograms:
                 if es_enabled:
 
                     def body(c, _):
-                        st, act, bst, pat, bp = c
+                        st, act, bst, pat, bp, seeded = c
                         act_pre = act
                         st2, losses = vm_epoch(st, X, mask, act)
                         improved = (losses < bst - delta) & (act > 0)
                         bst = jnp.where(improved, losses, bst)
-                        bp = _select_improved(
-                            improved.astype(jnp.float32), bp, st2.params
+                        # first epoch of a fresh run seeds best_params with
+                        # the post-epoch params for EVERY member (even
+                        # non-improving, e.g. NaN loss) — parity with the
+                        # per-epoch loop's unconditional first-epoch copy
+                        select = jnp.maximum(
+                            improved.astype(jnp.float32), 1.0 - seeded
                         )
+                        bp = _select_improved(select, bp, st2.params)
                         pat = jnp.where(
                             improved,
                             jnp.int32(es_p0),
@@ -154,7 +163,10 @@ class _BucketPrograms:
                         act = jnp.where(
                             (pat <= 0) & ~improved, 0.0, act
                         ).astype(jnp.float32)
-                        return (st2, act, bst, pat, bp), (losses, act_pre)
+                        return (st2, act, bst, pat, bp, jnp.float32(1.0)), (
+                            losses,
+                            act_pre,
+                        )
 
                 else:
 
@@ -290,9 +302,11 @@ class FleetTrainer:
         self.epoch_callback = epoch_callback
         # >1 = bounded-epoch chunks: K epochs per XLA dispatch with early
         # stopping evaluated on device; the host syncs once per chunk.
-        # Early-stopped models may run up to K-1 extra (masked) epochs and
-        # ES comparisons run in f32 instead of f64 — throughput for exact
-        # per-epoch host control (SURVEY.md §7 hard part 4).
+        # Early-stopped models may run up to K-1 extra (masked) epochs, ES
+        # comparisons run in f32 instead of f64, and checkpoints/callbacks
+        # can only land at chunk boundaries (an effective cadence of
+        # max(checkpoint_every, host_sync_every) epochs) — throughput for
+        # exact per-epoch host control (SURVEY.md §7 hard part 4).
         self.host_sync_every = int(host_sync_every)
         self.factory_kwargs = factory_kwargs
         self.last_stats: Dict[str, Any] = {}
@@ -587,6 +601,7 @@ class FleetTrainer:
                 # alias of st.params alongside st would break donation
                 return progs.chunk_fn(K, es_enabled, es_p0, delta)
 
+            seeded = jnp.float32(0.0 if best_params is None else 1.0)
             if es_enabled and best_params is None:
                 best_params = jax.tree.map(jnp.copy, states.params)
             carry = (
@@ -596,7 +611,7 @@ class FleetTrainer:
                 jnp.asarray(patience, jnp.int32),
             )
             if es_enabled:
-                carry = carry + (best_params,)
+                carry = carry + (best_params, seeded)
             epoch = start_epoch
             while epoch < self.epochs:
                 K = min(sync, self.epochs - epoch)
@@ -612,7 +627,7 @@ class FleetTrainer:
                 best = np.asarray(carry[2], np.float64)
                 patience = np.asarray(carry[3], np.int64)
                 if es_enabled:
-                    best_params = carry[4]
+                    best_params = carry[4]  # (seeded flag rides at carry[5])
                 after_epochs(epoch, list(losses_k), list(act_k))
                 epoch += K
                 if es_enabled and not active.any():
