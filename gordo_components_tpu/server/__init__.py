@@ -40,6 +40,8 @@ def build_app(
     app = web.Application(client_max_size=256 * 1024**2)
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
+    app["bank_enabled"] = use_bank
+    app["bank_config"] = {"max_batch": bank_max_batch, "flush_ms": bank_flush_ms}
     if use_bank:
         bank = ModelBank.from_models(collection.models)
         if len(bank):
@@ -52,13 +54,14 @@ def build_app(
                 engine.start()
                 app["bank_engine"] = engine
 
-            async def _stop_engine(app: web.Application) -> None:
-                engine = app.get("bank_engine")
-                if engine is not None:
-                    await engine.stop()
-
             app.on_startup.append(_start_engine)
-            app.on_cleanup.append(_stop_engine)
+
+    async def _stop_engine(app: web.Application) -> None:
+        engine = app.get("bank_engine")
+        if engine is not None:
+            await engine.stop()
+
+    app.on_cleanup.append(_stop_engine)
     app.add_routes(routes)
     return app
 

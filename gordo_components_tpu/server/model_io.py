@@ -23,24 +23,69 @@ class ModelCollection:
     ``root`` may be a single artifact dir (containing ``model.pkl``) —
     loaded under the name ``target_name or basename(root)`` — or a dir of
     artifact subdirs, each loaded under its subdir name.
+
+    :meth:`refresh` rescans the root and incrementally loads new or
+    changed artifacts (by ``model.pkl`` mtime) and drops removed ones, so
+    a running server can pick up freshly built fleet artifacts without a
+    restart (the reference redeployed a pod per model instead).
     """
 
     def __init__(self, root: str, target_name: Optional[str] = None):
         self.root = root
+        self.target_name = target_name
         self.models: Dict[str, Any] = {}
         self.metadata: Dict[str, Dict] = {}
-        if os.path.exists(os.path.join(root, "model.pkl")):
-            name = target_name or os.path.basename(os.path.normpath(root))
-            self._load_one(name, root)
-        else:
-            for entry in sorted(os.listdir(root)):
-                path = os.path.join(root, entry)
-                if os.path.isdir(path) and os.path.exists(
-                    os.path.join(path, "model.pkl")
-                ):
-                    self._load_one(entry, path)
+        self._mtimes: Dict[str, float] = {}
+        self.refresh()
         if not self.models:
             raise FileNotFoundError(f"No model artifacts found under {root!r}")
+
+    def _scan(self) -> Dict[str, str]:
+        """name -> artifact dir for the current on-disk state."""
+        if os.path.exists(os.path.join(self.root, "model.pkl")):
+            name = self.target_name or os.path.basename(os.path.normpath(self.root))
+            return {name: self.root}
+        out = {}
+        try:
+            entries = sorted(os.listdir(self.root))
+        except FileNotFoundError:
+            return {}
+        for entry in entries:
+            path = os.path.join(self.root, entry)
+            if os.path.isdir(path) and os.path.exists(os.path.join(path, "model.pkl")):
+                out[entry] = path
+        return out
+
+    def refresh(self) -> Dict[str, list]:
+        """Incremental rescan. Returns {"added": [...], "updated": [...],
+        "removed": [...]} by model name."""
+        on_disk = self._scan()
+        added, updated, removed = [], [], []
+        for name in list(self.models):
+            if name not in on_disk:
+                removed.append(name)
+                del self.models[name]
+                del self.metadata[name]
+                self._mtimes.pop(name, None)
+        for name, path in on_disk.items():
+            try:
+                mtime = os.path.getmtime(os.path.join(path, "model.pkl"))
+            except OSError:
+                continue
+            if name not in self.models:
+                self._load_one(name, path)
+                self._mtimes[name] = mtime
+                added.append(name)
+            elif mtime != self._mtimes.get(name):
+                self._load_one(name, path)
+                self._mtimes[name] = mtime
+                updated.append(name)
+        if added or updated or removed:
+            logger.info(
+                "Collection refresh: +%d ~%d -%d (now %d models)",
+                len(added), len(updated), len(removed), len(self.models),
+            )
+        return {"added": added, "updated": updated, "removed": removed}
 
     def _load_one(self, name: str, path: str) -> None:
         logger.info("Loading model %r from %s", name, path)

@@ -64,6 +64,46 @@ async def list_models(request: web.Request) -> web.Response:
     )
 
 
+@routes.post("/gordo/v0/{project}/reload")
+async def reload_models(request: web.Request) -> web.Response:
+    """Rescan the artifact dir and serve new/updated models without a
+    restart: the builder writes artifacts, then POSTs here (the reference
+    rolled a new pod per model instead). Rebuilds the HBM bank when
+    enabled."""
+    app = request.app
+    collection = _collection(request)
+    loop = asyncio.get_running_loop()
+    changes = await loop.run_in_executor(None, collection.refresh)
+    bank_models = None
+    if app.get("bank_enabled"):
+        from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
+
+        bank = await loop.run_in_executor(
+            None, ModelBank.from_models, collection.models
+        )
+        app["bank"] = bank
+        engine = app.get("bank_engine")
+        if engine is not None:
+            engine.bank = bank  # in-flight batches keep the old bank object
+        elif len(bank):
+            cfg = app.get("bank_config", {})
+            engine = BatchingEngine(
+                bank,
+                max_batch=cfg.get("max_batch", 64),
+                flush_ms=cfg.get("flush_ms", 2.0),
+            )
+            engine.start()
+            app["bank_engine"] = engine
+        bank_models = len(bank)
+    return web.json_response(
+        {
+            "changes": changes,
+            "models": collection.names(),
+            "bank_models": bank_models,
+        }
+    )
+
+
 @routes.get("/gordo/v0/{project}/{target}/healthcheck")
 async def healthcheck(request: web.Request) -> web.Response:
     _get_model(request)

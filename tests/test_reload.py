@@ -1,0 +1,90 @@
+"""Hot-reload tests: a running server must pick up newly built, updated,
+and removed artifacts via POST /reload, including bank rebuilds."""
+
+import contextlib
+import shutil
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+from gordo_components_tpu.server import build_app
+
+
+def _make_det(seed=0, scale=1.0):
+    X = (np.random.RandomState(seed).rand(120, 3) * scale).astype("float32")
+    det = DiffBasedAnomalyDetector(base_estimator=AutoEncoder(epochs=1, batch_size=64))
+    det.fit(X)
+    return det
+
+
+@contextlib.asynccontextmanager
+async def make_client(root):
+    client = TestClient(TestServer(build_app(str(root))))
+    await client.start_server()
+    try:
+        yield client
+    finally:
+        await client.close()
+
+
+@pytest.fixture()
+def root(tmp_path):
+    serializer.dump(_make_det(0), str(tmp_path / "m-a"), metadata={"name": "m-a"})
+    return tmp_path
+
+
+async def test_reload_picks_up_new_and_removed(root):
+    async with make_client(root) as client:
+        resp = await client.get("/gordo/v0/p/models")
+        assert (await resp.json())["models"] == ["m-a"]
+        # request for a not-yet-built model 404s
+        assert (await client.get("/gordo/v0/p/m-b/healthcheck")).status == 404
+
+        # builder writes a new artifact, then reloads the server
+        serializer.dump(_make_det(1), str(root / "m-b"), metadata={"name": "m-b"})
+        resp = await client.post("/gordo/v0/p/reload")
+        body = await resp.json()
+        assert body["changes"]["added"] == ["m-b"]
+        assert body["models"] == ["m-a", "m-b"]
+        assert body["bank_models"] == 2
+
+        # the new model serves through the bank path
+        resp = await client.post(
+            "/gordo/v0/p/m-b/anomaly/prediction",
+            json={"X": [[0.1, 0.2, 0.3]] * 4},
+        )
+        assert resp.status == 200
+        assert "total-anomaly-scaled" in (await resp.json())["data"]
+
+        # removal drops the target on next reload
+        shutil.rmtree(root / "m-a")
+        body = await (await client.post("/gordo/v0/p/reload")).json()
+        assert body["changes"]["removed"] == ["m-a"]
+        assert (await client.get("/gordo/v0/p/m-a/healthcheck")).status == 404
+
+
+async def test_reload_updated_artifact_changes_scores(root):
+    async with make_client(root) as client:
+        X = [[0.5, 0.5, 0.5]] * 3
+        r1 = await (
+            await client.post("/gordo/v0/p/m-a/anomaly/prediction", json={"X": X})
+        ).json()
+        # retrain with very different data scale and overwrite the artifact
+        serializer.dump(
+            _make_det(7, scale=100.0), str(root / "m-a"), metadata={"name": "m-a"}
+        )
+        body = await (await client.post("/gordo/v0/p/reload")).json()
+        assert body["changes"]["updated"] == ["m-a"]
+        r2 = await (
+            await client.post("/gordo/v0/p/m-a/anomaly/prediction", json={"X": X})
+        ).json()
+        assert r1["data"]["model-output"] != r2["data"]["model-output"]
+
+
+async def test_reload_noop(root):
+    async with make_client(root) as client:
+        body = await (await client.post("/gordo/v0/p/reload")).json()
+        assert body["changes"] == {"added": [], "updated": [], "removed": []}
