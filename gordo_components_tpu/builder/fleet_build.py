@@ -150,6 +150,7 @@ def build_fleet(
     """
     results: Dict[str, str] = {}
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
+    trainer_mesh = None
 
     if distributed:
         # pod-scale gang: every host runs this same function; each owns a
@@ -160,7 +161,20 @@ def build_fleet(
             partition_members,
         )
 
-        if not initialize_distributed():
+        if initialize_distributed():
+            # members are partitioned per host, so each host's member stack
+            # is host-local and differently shaped: the trainer mesh must
+            # span only THIS host's devices. A global mesh (jax.devices()
+            # spans the whole pod under jax.distributed) would device_put
+            # host-local data onto a non-addressable sharding and trace
+            # per-host-different programs — an SPMD violation. The global
+            # runtime is kept only for the rendezvous/partition step.
+            import jax
+
+            from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+            trainer_mesh = fleet_mesh(devices=jax.local_devices())
+        else:
             # misconfigured rendezvous silently degrading would make EVERY
             # worker own the full fleet: duplicated training + racing
             # artifact writes. Be loud; proceed only because a genuine
@@ -203,6 +217,7 @@ def build_fleet(
         _build_fleet_group(
             group, output_dir, model_register_dir, replace_cache, results,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            mesh=trainer_mesh,
         )
     return results
 
@@ -215,6 +230,7 @@ def _build_fleet_group(
     results: Dict[str, str],
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
+    mesh=None,
 ) -> None:
     ae_kwargs = copy.deepcopy(group[0][1])
 
@@ -249,7 +265,7 @@ def _build_fleet_group(
     }
     trainer = FleetTrainer(
         checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-        **trainer_kwargs, **ae_kwargs,
+        mesh=mesh, **trainer_kwargs, **ae_kwargs,
     )
     t1 = time.time()
     from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile

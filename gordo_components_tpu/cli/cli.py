@@ -140,6 +140,20 @@ def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_di
     click.echo(json.dumps(results, indent=2))
 
 
+@gordo.command("checkpoint-prune")
+@click.option("--checkpoint-dir", envvar="CHECKPOINT_DIR", required=True)
+@click.option("--older-than-days", default=7.0, type=float,
+              help="Delete bucket checkpoints untouched for this long")
+def checkpoint_prune_cmd(checkpoint_dir, older_than_days):
+    """Explicit janitor for stranded fleet checkpoints (checkpoints whose
+    config/data key will never be computed again accumulate forever on a
+    shared volume; pruning is deliberately NOT a side effect of builds)."""
+    from gordo_components_tpu.parallel.checkpoint import prune_stale_checkpoints
+
+    n = prune_stale_checkpoints(checkpoint_dir, older_than_days)
+    click.echo(f"Pruned {n} stale checkpoint(s)")
+
+
 @gordo.command("run-server")
 @click.option("--model-dir", envvar="MODEL_COLLECTION_DIR", required=True)
 @click.option("--host", default="0.0.0.0", envvar="SERVER_HOST")

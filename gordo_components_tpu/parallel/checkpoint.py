@@ -135,36 +135,50 @@ class FleetBucketCheckpoint:
             return host
         return None
 
-    def clear(self, prune_stale_after_days: Optional[float] = 7.0) -> None:
+    def clear(self, prune_stale_after_days: Optional[float] = None) -> None:
         """Remove the checkpoint (bucket finished; artifact is persistence
-        now). Also prunes *sibling* keys untouched for
-        ``prune_stale_after_days`` — checkpoints stranded by a config/data
-        change (their key will never be computed again) would otherwise
-        accumulate forever on a shared checkpoint volume."""
+        now).
+
+        Stale-*sibling* pruning is opt-in (``prune_stale_after_days``):
+        deleting other keys' state as a side effect of a successful bucket
+        would silently destroy the resumable state of a legitimately
+        paused/backlogged gang. Use :func:`prune_stale_checkpoints` (or the
+        ``checkpoint-prune`` CLI) as an explicit janitor instead."""
         if os.path.isdir(self.root):
             shutil.rmtree(self.root, ignore_errors=True)
         if prune_stale_after_days is None:
             return
-        import time
+        prune_stale_checkpoints(os.path.dirname(self.root), prune_stale_after_days)
 
-        parent = os.path.dirname(self.root)
-        if not os.path.isdir(parent):
-            return
-        cutoff = time.time() - prune_stale_after_days * 86400
-        for entry in os.listdir(parent):
-            path = os.path.join(parent, entry)
-            try:
-                # only touch directories that are unmistakably our
-                # checkpoints (24-hex key containing integer epoch dirs) —
-                # checkpoint_dir may be a shared volume with other data
-                if not (
-                    os.path.isdir(path)
-                    and _KEY_RE.fullmatch(entry)
-                    and all(e.isdigit() for e in os.listdir(path))
-                ):
-                    continue
-                if os.path.getmtime(path) < cutoff:
-                    logger.info("Pruning stale fleet checkpoint %s", path)
-                    shutil.rmtree(path, ignore_errors=True)
-            except OSError:
+
+def prune_stale_checkpoints(checkpoint_dir: str, older_than_days: float) -> int:
+    """Explicit janitor: delete bucket checkpoints untouched for
+    ``older_than_days``. Checkpoints stranded by a config/data change (their
+    key will never be computed again) would otherwise accumulate forever on
+    a shared checkpoint volume. Returns the number pruned."""
+    import time
+
+    parent = os.path.abspath(checkpoint_dir)
+    if not os.path.isdir(parent):
+        return 0
+    cutoff = time.time() - float(older_than_days) * 86400
+    pruned = 0
+    for entry in os.listdir(parent):
+        path = os.path.join(parent, entry)
+        try:
+            # only touch directories that are unmistakably our
+            # checkpoints (24-hex key containing integer epoch dirs) —
+            # checkpoint_dir may be a shared volume with other data
+            if not (
+                os.path.isdir(path)
+                and _KEY_RE.fullmatch(entry)
+                and all(e.isdigit() for e in os.listdir(path))
+            ):
                 continue
+            if os.path.getmtime(path) < cutoff:
+                logger.warning("Pruning stale fleet checkpoint %s", path)
+                shutil.rmtree(path, ignore_errors=True)
+                pruned += 1
+        except OSError:
+            continue
+    return pruned

@@ -75,7 +75,7 @@ def _affine_from_scaler(step, n_features: int):
     if params is not None:  # JaxMinMaxScaler / JaxStandardScaler
         return np.asarray(params.shift), np.asarray(params.scale)
     cls = type(step).__name__
-    if cls == "MinMaxScaler" and hasattr(step, "scale_"):
+    if cls == "MinMaxScaler" and getattr(step, "scale_", None) is not None:
         scale = np.asarray(step.scale_, np.float32)
         return (-np.asarray(step.min_, np.float32) / scale), scale
     if cls == "StandardScaler" and hasattr(step, "scale_"):
@@ -83,7 +83,11 @@ def _affine_from_scaler(step, n_features: int):
         shift = np.asarray(
             mean if mean is not None else np.zeros(n_features), np.float32
         )
-        return shift, 1.0 / np.asarray(step.scale_, np.float32)
+        # with_std=False leaves scale_ = None: a pure-centering affine
+        scale_ = step.scale_
+        if scale_ is None:
+            return shift, np.ones((n_features,), np.float32)
+        return shift, 1.0 / np.asarray(scale_, np.float32)
     return None
 
 
@@ -257,7 +261,18 @@ class ModelBank:
     def from_models(cls, models: Dict[str, Any], **kwargs) -> "ModelBank":
         bank = cls(**kwargs)
         for name, model in models.items():
-            entry = _extract_entry(name, model)
+            try:
+                entry = _extract_entry(name, model)
+            except Exception:
+                # one malformed model must not abort bank construction for
+                # the whole collection (this runs at server startup and in
+                # /reload); the model still serves via the per-model path
+                logger.warning(
+                    "Model %r: bank extraction failed; per-model path",
+                    name,
+                    exc_info=True,
+                )
+                continue
             if entry is None:
                 logger.debug("Model %r is not bankable; per-model path", name)
                 continue
@@ -446,9 +461,28 @@ class BatchingEngine:
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
+        batch: List[_Pending] = []
+        try:
+            await self._run_loop(loop, batch)
+        finally:
+            # stop()/cancellation: resolve every future still waiting (the
+            # partially-collected batch plus anything queued) so callers
+            # awaiting score() don't hang forever at shutdown
+            pending = list(batch)
+            while True:
+                try:
+                    pending.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            for p in pending:
+                if not p.future.done():
+                    p.future.cancel()
+
+    async def _run_loop(self, loop, batch: List[_Pending]) -> None:
         while True:
+            batch.clear()
             first = await self._queue.get()
-            batch = [first]
+            batch.append(first)
             deadline = time.monotonic() + self.flush_s
             while len(batch) < self.max_batch:
                 timeout = deadline - time.monotonic()

@@ -69,32 +69,43 @@ async def reload_models(request: web.Request) -> web.Response:
     """Rescan the artifact dir and serve new/updated models without a
     restart: the builder writes artifacts, then POSTs here (the reference
     rolled a new pod per model instead). Rebuilds the HBM bank when
-    enabled."""
+    enabled.
+
+    Serialized with an app-level lock: concurrent reloads would otherwise
+    run ``collection.refresh()`` on separate executor threads (mutating
+    models/metadata under readers) and each would rebuild the full HBM
+    bank — making repeated POSTs a cheap DoS on device memory/compute."""
     app = request.app
+    # aiohttp handlers all run on the one event loop thread, and there is
+    # no await between the check and the set, so this lazy init is safe
+    lock = app.get("reload_lock")
+    if lock is None:
+        lock = app["reload_lock"] = asyncio.Lock()
     collection = _collection(request)
     loop = asyncio.get_running_loop()
-    changes = await loop.run_in_executor(None, collection.refresh)
-    bank_models = None
-    if app.get("bank_enabled"):
-        from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
+    async with lock:
+        changes = await loop.run_in_executor(None, collection.refresh)
+        bank_models = None
+        if app.get("bank_enabled"):
+            from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 
-        bank = await loop.run_in_executor(
-            None, ModelBank.from_models, collection.models
-        )
-        app["bank"] = bank
-        engine = app.get("bank_engine")
-        if engine is not None:
-            engine.bank = bank  # in-flight batches keep the old bank object
-        elif len(bank):
-            cfg = app.get("bank_config", {})
-            engine = BatchingEngine(
-                bank,
-                max_batch=cfg.get("max_batch", 64),
-                flush_ms=cfg.get("flush_ms", 2.0),
+            bank = await loop.run_in_executor(
+                None, ModelBank.from_models, collection.models
             )
-            engine.start()
-            app["bank_engine"] = engine
-        bank_models = len(bank)
+            app["bank"] = bank
+            engine = app.get("bank_engine")
+            if engine is not None:
+                engine.bank = bank  # in-flight batches keep the old bank object
+            elif len(bank):
+                cfg = app.get("bank_config", {})
+                engine = BatchingEngine(
+                    bank,
+                    max_batch=cfg.get("max_batch", 64),
+                    flush_ms=cfg.get("flush_ms", 2.0),
+                )
+                engine.start()
+                app["bank_engine"] = engine
+            bank_models = len(bank)
     return web.json_response(
         {
             "changes": changes,

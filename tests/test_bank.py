@@ -166,3 +166,51 @@ async def test_batching_engine_propagates_errors(fleet_models):
         assert isinstance(bad, ValueError)
     finally:
         await engine.stop()
+
+
+def test_bank_standard_scaler_without_std(fleet_models):
+    """StandardScaler(with_std=False) leaves scale_=None: the bank must
+    treat it as a pure-centering affine (ADVICE r1), not crash."""
+    from sklearn.preprocessing import StandardScaler
+
+    _, data = fleet_models
+    X = data["plain"]
+    det = _make_det(X, scaler=StandardScaler(with_std=False))
+    bank = ModelBank.from_models({"centered": det})
+    assert "centered" in bank
+    got = bank.score("centered", X[:20]).to_frame()
+    expected = det.anomaly(X[:20])
+    pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_bank_extraction_failure_isolated(fleet_models):
+    """One model whose extraction raises must not abort bank construction
+    for the whole collection (runs at server startup and /reload)."""
+    models, data = fleet_models
+
+    class _Boom:
+        @property
+        def scaler_params_(self):
+            raise RuntimeError("boom")
+
+    broken = _make_det(data["plain"])
+    broken.base_estimator = Pipeline(
+        [("scale", _Boom()), ("model", broken.base_estimator)]
+    )
+    bank = ModelBank.from_models({**models, "broken": broken})
+    assert "broken" not in bank
+    assert len(bank) == len(models)  # everything else still banked
+
+
+async def test_batching_engine_stop_resolves_pending(fleet_models):
+    """A request awaiting engine.score() at shutdown must be cancelled,
+    not hang forever (ADVICE r1)."""
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    # huge flush window: the request sits collected-but-unscored at stop()
+    engine = BatchingEngine(bank, max_batch=64, flush_ms=60_000.0)
+    task = asyncio.ensure_future(engine.score("plain", data["plain"][:8]))
+    await asyncio.sleep(0.05)
+    await engine.stop()
+    with pytest.raises(asyncio.CancelledError):
+        await task

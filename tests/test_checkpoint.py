@@ -152,3 +152,40 @@ def test_key_stability():
     b = bucket_checkpoint_key(["x", 1, ["m1", "m2"]])
     c = bucket_checkpoint_key(["x", 1, ["m1", "m3"]])
     assert a == b != c
+
+
+def _fake_bucket_dir(parent, key, age_days=0.0):
+    import time
+
+    path = os.path.join(str(parent), key)
+    os.makedirs(os.path.join(path, "0"))
+    if age_days:
+        old = time.time() - age_days * 86400
+        os.utime(path, (old, old))
+    return path
+
+
+def test_clear_does_not_prune_siblings_by_default(tmp_path):
+    """clear() removing OTHER buckets' state as a side effect would destroy
+    a paused gang's resumable state (ADVICE r1): pruning is opt-in."""
+    stale = _fake_bucket_dir(tmp_path, "a" * 24, age_days=30)
+    ckpt = FleetBucketCheckpoint(str(tmp_path), "b" * 24)
+    os.makedirs(os.path.join(ckpt.root, "0"))
+    ckpt.clear()
+    assert not os.path.isdir(ckpt.root)
+    assert os.path.isdir(stale)  # sibling untouched
+
+
+def test_prune_stale_checkpoints_janitor(tmp_path):
+    from gordo_components_tpu.parallel.checkpoint import prune_stale_checkpoints
+
+    stale = _fake_bucket_dir(tmp_path, "a" * 24, age_days=30)
+    fresh = _fake_bucket_dir(tmp_path, "b" * 24, age_days=0)
+    not_ours = os.path.join(str(tmp_path), "user-data")
+    os.makedirs(not_ours)
+    old = __import__("time").time() - 60 * 86400
+    os.utime(not_ours, (old, old))
+    assert prune_stale_checkpoints(str(tmp_path), older_than_days=7) == 1
+    assert not os.path.isdir(stale)
+    assert os.path.isdir(fresh)
+    assert os.path.isdir(not_ours)  # non-checkpoint dirs never touched
