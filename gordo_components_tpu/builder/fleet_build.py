@@ -53,7 +53,7 @@ _TRAINER_KEYS = frozenset(
     {
         "kind", "epochs", "batch_size", "learning_rate", "optimizer",
         "early_stopping_patience", "early_stopping_min_delta", "seed",
-        "compute_dtype",
+        "compute_dtype", "quantize_rows",
     }
 )
 _FACTORY_KEYS = frozenset(

@@ -125,9 +125,21 @@ def make_train_fns(
     def epoch_fn(state: TrainState, X, Y, mask):
         n_pad = X.shape[0]
         n_batches = n_pad // batch_size
-        keys = jax.random.split(state.rng, n_batches + 2)
-        rng, perm_rng, rngs = keys[0], keys[1], keys[2:]
-        perm = jax.random.permutation(perm_rng, n_pad)
+        # rng consumption is deliberately INDEPENDENT of n_batches (three
+        # splits + fold_in per batch index): training a dataset padded to a
+        # larger row bucket consumes the same random stream, which is what
+        # makes the fleet engine's row-count quantization a true no-op
+        rng, perm_rng, batch_base = jax.random.split(state.rng, 3)
+        rngs = jax.vmap(lambda i: jax.random.fold_in(batch_base, i))(
+            jnp.arange(n_batches)
+        )
+        # shuffle real rows among themselves and sort padding to the END
+        # (stable argsort of prefix-stable uniform keys): real rows stay
+        # densely packed in the leading batches — the effective batch size
+        # is preserved no matter how much row padding the bucket adds, and
+        # any fully-padded trailing batch is skipped as a no-op below.
+        keys = jax.random.uniform(perm_rng, (n_pad,))
+        perm = jnp.argsort(jnp.where(mask > 0, keys, 2.0))
         Xs = X[perm].reshape((n_batches, batch_size) + X.shape[1:])
         Ys = Y[perm].reshape((n_batches, batch_size) + Y.shape[1:])
         Ms = mask[perm].reshape((n_batches, batch_size))
@@ -136,11 +148,21 @@ def make_train_fns(
             params, opt_state = carry
             xb, yb, mb, brng = batch
             loss_val, grads = jax.value_and_grad(loss_fn)(params, brng, xb, yb, mb)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # all-padding batches must be EXACT no-ops: even zero gradients
+            # advance adam's bias-correction count and decay its momentum,
+            # which would silently change training dynamics with row padding
+            has_real = jnp.sum(mb) > 0
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(has_real, n, o), new, old
+            )
             # weight the batch loss by its real-row count for a correct
             # dataset-mean when the last batch is partly padding
-            return (params, opt_state), (loss_val, jnp.sum(mb))
+            return (keep(new_params, params), keep(new_opt_state, opt_state)), (
+                loss_val,
+                jnp.sum(mb),
+            )
 
         (params, opt_state), (losses, counts) = jax.lax.scan(
             step, (state.params, state.opt_state), (Xs, Ys, Ms, rngs)

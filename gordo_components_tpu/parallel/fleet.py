@@ -181,6 +181,29 @@ class _BucketPrograms:
         return self._chunks[key]
 
 
+def quantize_batch_count(n: int) -> int:
+    """Round a per-member batch count UP to the {1, 2, 3, 4, 6, 8, 12, 16,
+    24, 32, ...} ladder (powers of two and their 1.5x midpoints).
+
+    Real fleets have ragged history lengths; bucketing on exact padded row
+    counts would shatter 10k machines into O(distinct row counts) XLA
+    programs with tiny vmap widths (SURVEY.md §7 hard part 1). The ladder
+    caps the program count at O(log rows) per feature count while bounding
+    padded-row waste at 33% — and the padding itself is a true no-op:
+    ``epoch_fn`` packs real rows densely into the leading batches and skips
+    fully-padded trailing batches without touching params or opt state.
+    """
+    if n <= 2:
+        return max(1, n)
+    p = 2
+    while True:
+        if n <= p + p // 2:
+            return p + p // 2
+        p *= 2
+        if n <= p:
+            return p
+
+
 _PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
 
 
@@ -279,6 +302,7 @@ class FleetTrainer:
         checkpoint_every: int = 1,
         epoch_callback=None,
         host_sync_every: int = 1,
+        quantize_rows: bool = True,
         **factory_kwargs,
     ):
         self.kind = kind
@@ -308,6 +332,9 @@ class FleetTrainer:
         # max(checkpoint_every, host_sync_every) epochs) — throughput for
         # exact per-epoch host control (SURVEY.md §7 hard part 4).
         self.host_sync_every = int(host_sync_every)
+        # bucket members on the batch-count ladder (see
+        # quantize_batch_count) instead of exact padded row counts
+        self.quantize_rows = bool(quantize_rows)
         self.factory_kwargs = factory_kwargs
         self.last_stats: Dict[str, Any] = {}
 
@@ -332,6 +359,8 @@ class FleetTrainer:
             if X.ndim != 2 or X.shape[0] < 1:
                 raise ValueError(f"Member {name!r}: need (rows, features), got {X.shape}")
             n_batches = -(-X.shape[0] // self.batch_size)
+            if self.quantize_rows:
+                n_batches = quantize_batch_count(n_batches)
             key = (X.shape[1], n_batches * self.batch_size)
             buckets.setdefault(key, []).append(name)
 

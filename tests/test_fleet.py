@@ -157,3 +157,64 @@ class TestFleetTrainer:
         models = trainer.fit(members)
         for m in models.values():
             assert len(m.history["loss"]) == 2
+
+
+class TestRowQuantization:
+    """Ragged row counts must collapse onto the batch-count ladder: O(few)
+    compiled programs per feature count, with padding a true no-op."""
+
+    def test_ladder_values(self):
+        from gordo_components_tpu.parallel.fleet import quantize_batch_count
+
+        got = [quantize_batch_count(n) for n in [1, 2, 3, 4, 5, 6, 7, 8, 9, 12, 13, 17, 25]]
+        assert got == [1, 2, 3, 4, 6, 6, 8, 8, 12, 12, 16, 24, 32]
+        # upper bound on padded waste: 33%
+        for n in range(1, 500):
+            q = quantize_batch_count(n)
+            assert n <= q <= max(2, (n * 3 + 1) // 2)
+
+    def test_quantization_is_noop_for_member_results(self):
+        """The SAME members trained with quantization on (rows padded to a
+        bigger bucket) vs off must produce identical per-member models:
+        real rows stay densely packed in leading batches, trailing all-pad
+        batches skip params AND opt state."""
+        rng = np.random.RandomState(7)
+        # 300 rows, bs=64 -> 5 batches exact, 6 on the ladder (384 rows)
+        members = {f"m-{i}": rng.rand(300, 4).astype("float32") for i in range(6)}
+        common = dict(kind="feedforward_hourglass", epochs=3, batch_size=64, seed=11)
+        exact = FleetTrainer(quantize_rows=False, **common).fit(members)
+        quant = FleetTrainer(quantize_rows=True, **common).fit(members)
+        for name in members:
+            np.testing.assert_allclose(
+                exact[name].history["loss"], quant[name].history["loss"], rtol=1e-5
+            )
+            for le, lq in zip(
+                jax.tree.leaves(exact[name].params), jax.tree.leaves(quant[name].params)
+            ):
+                np.testing.assert_allclose(le, lq, rtol=1e-5, atol=1e-7)
+
+    def test_ragged_fleet_compiles_few_programs(self):
+        """64 members with 64 DISTINCT row counts must land in <=4 buckets
+        (the unquantized path would shatter into ~6)."""
+        rng = np.random.RandomState(3)
+        rows = [700 + 11 * i for i in range(64)]  # 700..1393, all distinct
+        members = {
+            f"m-{i}": rng.rand(r, 5).astype("float32") for i, r in enumerate(rows)
+        }
+        common = dict(kind="feedforward_hourglass", epochs=2, batch_size=128, seed=0)
+        trainer = FleetTrainer(quantize_rows=True, **common)
+        out = trainer.fit(members)
+        assert len(out) == 64
+        n_quant = len(trainer.last_stats["buckets"])
+        assert n_quant <= 4
+        # every member trained: full history, finite losses
+        for fm in out.values():
+            assert len(fm.history["loss"]) == 2
+            assert np.isfinite(fm.history["loss"]).all()
+        # and quantization genuinely coalesced distinct row counts
+        unq = FleetTrainer(quantize_rows=False, **common)
+        unq_buckets = {}
+        for name, X in members.items():
+            nb = -(-X.shape[0] // 128)
+            unq_buckets.setdefault(nb, []).append(name)
+        assert len(unq_buckets) > n_quant
