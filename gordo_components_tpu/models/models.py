@@ -38,6 +38,8 @@ def _as_float32(X) -> np.ndarray:
     return np.asarray(X, dtype=np.float32)
 
 
+
+
 class BaseEstimator(GordoBase):
     """Shared engine for all autoencoder estimators.
 
@@ -66,6 +68,7 @@ class BaseEstimator(GordoBase):
         early_stopping_min_delta: float = 0.0,
         seed: int = 0,
         compute_dtype: str = "float32",
+        data_parallel: bool = False,
         **factory_kwargs,
     ):
         self.kind = kind
@@ -80,6 +83,9 @@ class BaseEstimator(GordoBase):
         self.early_stopping_min_delta = float(early_stopping_min_delta)
         self.seed = int(seed)
         self.compute_dtype = compute_dtype
+        # train with batch rows sharded over all devices (ICI DP) when more
+        # than one device is visible; see fit() for the sharding design
+        self.data_parallel = bool(data_parallel)
         self.factory_kwargs = factory_kwargs
         # fitted state
         self.params_ = None
@@ -149,6 +155,35 @@ class BaseEstimator(GordoBase):
             module, opt, bs, loss=loss, kl_weight=self.kl_weight
         )
         epoch_fn = jax.jit(epoch_fn, donate_argnums=(0,))
+
+        # ---- data parallelism (BASELINE.json north star: DP over ICI) ----
+        # Swap in the shard_map DP epoch: each batch's ROWS split across
+        # the data mesh, gradients reconstructed with a count-weighted
+        # psum (parallel/dp.py). Same shuffle, same rng stream -> same
+        # model as the single-device fit; only the per-row gradient work
+        # is partitioned. Runs on the largest device count dividing the
+        # batch size so the split is exact.
+        if self.data_parallel:
+            from gordo_components_tpu.parallel.dp import (
+                data_mesh,
+                dp_device_count,
+                make_dp_epoch_fn,
+            )
+
+            n_dp = dp_device_count(bs, len(jax.devices()))
+            if n_dp > 1:
+                dp_mesh = data_mesh(n_dp)
+                epoch_fn = make_dp_epoch_fn(
+                    module, opt, bs, dp_mesh, loss=loss, kl_weight=self.kl_weight
+                )
+                logger.info(
+                    "Data-parallel fit: batch %d split over %d devices", bs, n_dp
+                )
+            else:
+                logger.info(
+                    "data_parallel requested but unusable (1 usable device "
+                    "for batch_size=%d); single-device fit", bs,
+                )
 
         Xp, Yp, mask, _ = train_core.pad_to_batches(Xtr, Ytr, bs)
         Xp, Yp, mask = jnp.asarray(Xp), jnp.asarray(Yp), jnp.asarray(mask)
