@@ -277,6 +277,39 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
     }
 
 
+def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
+    """Config 5 extension — sequence models served from the HBM bank
+    (windowing runs in-graph with the bucket's static lookback)."""
+    from gordo_components_tpu.models import DiffBasedAnomalyDetector, LSTMAutoEncoder
+    from gordo_components_tpu.server.bank import ModelBank
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, n_features).astype("float32")
+    models = {}
+    for i in range(n_models):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=LSTMAutoEncoder(
+                lookback_window=32, epochs=1, batch_size=256,
+                compute_dtype="bfloat16",
+            )
+        )
+        det.fit(X + 0.01 * i)
+        models[f"s-{i}"] = det
+    bank = ModelBank.from_models(models)
+    requests = [
+        (f"s-{i}", rng.rand(rows, n_features).astype("float32"), None)
+        for i in range(n_models)
+    ]
+    [r.to_frame() for r in bank.score_many(requests)]  # warm/compile
+    t0 = time.time()
+    for _ in range(iters):
+        [r.to_frame() for r in bank.score_many(requests)]
+    elapsed = time.time() - t0
+    return {
+        "lstm_bank_samples_per_sec": round(n_models * rows * iters / elapsed, 1)
+    }
+
+
 def bench_server_scoring(n_features=10, batch=4096, iters=20):
     """Reconstruction-error samples/sec through the jit'd scoring path."""
     import jax
@@ -329,6 +362,7 @@ def main():
         ("sequential", bench_single_sequential),
         ("server_scoring", bench_server_scoring),
         ("bank_serving", bench_bank_serving),
+        ("bank_sequence", bench_bank_sequence),
         ("model_zoo", bench_sequence_models),
     ):
         try:

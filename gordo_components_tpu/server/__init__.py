@@ -44,8 +44,10 @@ def build_app(
     app["bank_config"] = {"max_batch": bank_max_batch, "flush_ms": bank_flush_ms}
     if use_bank:
         bank = ModelBank.from_models(collection.models)
+        # expose the bank even when nothing banked: /models reports the
+        # coverage (banked vs per-model fallback, with reasons)
+        app["bank"] = bank
         if len(bank):
-            app["bank"] = bank
 
             async def _start_engine(app: web.Application) -> None:
                 engine = BatchingEngine(

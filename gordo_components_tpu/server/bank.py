@@ -16,11 +16,13 @@ gather into the stack inside a single jit'd scoring program, so
 - request shapes are bucketed to powers of two so the number of compiled
   programs stays O(log(max_rows) * log(max_batch)) regardless of traffic.
 
-Bankable = DiffBasedAnomalyDetector over a feedforward AutoEncoder with at
-most one affine scaler in front (the reference's default pipeline shape).
-Sequence models (LSTM/conv windows) and bespoke pipelines fall back to the
-per-model scoring path in views.py — same response schema either way, via
-the shared ``assemble_anomaly_frame``.
+Bankable = DiffBasedAnomalyDetector over any zoo estimator (feedforward,
+LSTM, forecast, conv — sequence windowing runs in-graph per bucket with
+its static lookback) with any chain of affine scalers in front. Bespoke
+pipelines (non-affine preprocessing, custom estimator classes) fall back
+to the per-model scoring path in views.py — same response schema either
+way, via the shared ``assemble_anomaly_frame`` — and the fallback set is
+surfaced per model through ``ModelBank.coverage`` and ``GET /models``.
 """
 
 import asyncio
@@ -53,10 +55,13 @@ logger = logging.getLogger(__name__)
 @dataclass
 class _BankEntry:
     name: str
+    registry_type: str  # estimator class name -> factory registry
     kind: str
     factory_kwargs: Dict[str, Any]
     compute_dtype: str
     n_features: int
+    lookback: int  # 1 for feedforward
+    target_offset: int  # sequence models: 0 reconstruct, 1 forecast
     params: Any  # numpy pytree
     in_shift: np.ndarray
     in_scale: np.ndarray
@@ -91,47 +96,65 @@ def _affine_from_scaler(step, n_features: int):
     return None
 
 
-def _extract_entry(name: str, model) -> Optional[_BankEntry]:
-    """Decompose a served model into bank pieces; None if not bankable."""
+# estimator classes whose scoring the bank can reproduce exactly; the
+# registry type doubles as the factory namespace (models/register.py)
+_BANKABLE_TYPES = {"AutoEncoder", "LSTMAutoEncoder", "LSTMForecast", "ConvAutoEncoder"}
+
+
+def _extract_entry(name: str, model) -> Tuple[Optional[_BankEntry], Optional[str]]:
+    """Decompose a served model into bank pieces.
+
+    Returns ``(entry, None)`` when bankable, else ``(None, reason)`` — the
+    reason is surfaced through :meth:`ModelBank.coverage` so an operator
+    can see exactly which models fell back to the per-model path and why.
+    """
     if not isinstance(model, DiffBasedAnomalyDetector):
-        return None
+        return None, f"not a DiffBasedAnomalyDetector ({type(model).__name__})"
     if model.error_scaler_ is None:
-        return None
+        return None, "detector is unfitted (no error scaler)"
     base = model.base_estimator
     pre_steps: Sequence = []
     if hasattr(base, "steps"):
         pre_steps, est = base.steps[:-1], base.steps[-1][1]
     else:
         est = base
-    # feedforward only: sequence estimators have a lookback warm-up offset
-    if type(est).__name__ != "AutoEncoder" or est.params_ is None:
-        return None
+    registry_type = type(est).__name__
+    if registry_type not in _BANKABLE_TYPES:
+        return None, f"unsupported estimator class {registry_type}"
+    if getattr(est, "params_", None) is None:
+        return None, "estimator is unfitted"
     n_features = est.n_features_
     # compose the (possibly chained) affine scalers into one:
     # t(x) = (x - in_shift) * in_scale; appending ((t - s) * k) gives
     # (x - (in_shift + s/in_scale)) * (in_scale * k)
     in_shift = np.zeros((n_features,), np.float32)
     in_scale = np.ones((n_features,), np.float32)
-    for _, step in pre_steps:
+    for step_name, step in pre_steps:
         aff = _affine_from_scaler(step, n_features)
         if aff is None:
-            return None  # non-affine preprocessing -> per-model path
+            return None, f"non-affine preprocessing step {step_name!r}"
         s, k = np.asarray(aff[0], np.float32), np.asarray(aff[1], np.float32)
         safe_scale = np.where(in_scale == 0, 1.0, in_scale)
         in_shift = in_shift + s / safe_scale
         in_scale = in_scale * k
     err = ScalerParams(*model.error_scaler_)
-    return _BankEntry(
-        name=name,
-        kind=est.kind,
-        factory_kwargs=dict(est.factory_kwargs),
-        compute_dtype=getattr(est, "compute_dtype", "float32"),
-        n_features=int(n_features),
-        params=jax.tree.map(np.asarray, est.params_),
-        in_shift=in_shift.astype(np.float32),
-        in_scale=in_scale.astype(np.float32),
-        err_shift=np.asarray(err.shift, np.float32),
-        err_scale=np.asarray(err.scale, np.float32),
+    return (
+        _BankEntry(
+            name=name,
+            registry_type=registry_type,
+            kind=est.kind,
+            factory_kwargs=dict(est.factory_kwargs),
+            compute_dtype=getattr(est, "compute_dtype", "float32"),
+            n_features=int(n_features),
+            lookback=int(getattr(est, "lookback_window", 1)),
+            target_offset=int(getattr(est, "_target_offset", 0)),
+            params=jax.tree.map(np.asarray, est.params_),
+            in_shift=in_shift.astype(np.float32),
+            in_scale=in_scale.astype(np.float32),
+            err_shift=np.asarray(err.shift, np.float32),
+            err_scale=np.asarray(err.scale, np.float32),
+        ),
+        None,
     )
 
 
@@ -148,9 +171,14 @@ def _prev_pow2(n: int) -> int:
 
 
 class _Bucket:
-    """All models sharing (kind, n_features, factory kwargs, dtype): one
-    stacked params pytree + scaler stacks in HBM, one scoring fn reused for
-    every (batch, rows) shape bucket."""
+    """All models sharing (type, kind, n_features, lookback, factory
+    kwargs, dtype): one stacked params pytree + scaler stacks in HBM, one
+    scoring fn reused for every (batch, rows) shape bucket.
+
+    Sequence models bank too: windowing runs in-graph
+    (``ops/windows.sliding_windows``) with the bucket's static lookback,
+    and outputs carry the warm-up ``offset`` (output row i <- input row
+    i + offset), exactly like the per-model path."""
 
     def __init__(
         self,
@@ -158,17 +186,27 @@ class _Bucket:
         n_features: int,
         factory_kwargs: Dict[str, Any],
         compute_dtype: str = "float32",
+        registry_type: str = "AutoEncoder",
+        lookback: int = 1,
+        target_offset: int = 0,
     ):
         self.kind = kind
         self.n_features = n_features
         self.factory_kwargs = factory_kwargs
         self.compute_dtype = compute_dtype
+        self.registry_type = registry_type
+        self.lookback = int(lookback)
+        self.target_offset = int(target_offset)
         self.names: List[str] = []
         self._entries: List[_BankEntry] = []
         # device state, built by finalize()
         self.params = None
         self.scalers = None  # (in_shift, in_scale, err_shift, err_scale)
         self._score = None
+
+    @property
+    def offset(self) -> int:
+        return self.lookback - 1 + self.target_offset
 
     def add(self, entry: _BankEntry) -> None:
         self._entries.append(entry)
@@ -183,23 +221,33 @@ class _Bucket:
             jax.device_put(np.stack([getattr(e, f) for e in self._entries]))
             for f in ("in_shift", "in_scale", "err_shift", "err_scale")
         )
-        module = lookup_factory("AutoEncoder", self.kind)(
+        module = lookup_factory(self.registry_type, self.kind)(
             self.n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
         )
+        lookback, t_off, off = self.lookback, self.target_offset, self.offset
 
         def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
             # idx: (B,) int32; X/Y: (B, T, F) raw-space
             from gordo_components_tpu.ops.pallas_score import _jnp_score
+            from gordo_components_tpu.ops.windows import sliding_windows
 
             def one(i, x, y):
                 p = jax.tree.map(lambda a: a[i], params)
                 xs = (x - in_shift[i]) * in_scale[i]
                 ys = (y - in_shift[i]) * in_scale[i]
-                recon = module.apply(p, xs)
+                if lookback > 1:
+                    W = sliding_windows(xs, lookback)
+                    if t_off:
+                        W = W[:-t_off]
+                    recon = module.apply(p, W)  # (T - off, F)
+                    target = ys[off : off + recon.shape[0]]
+                else:
+                    recon = module.apply(p, xs)
+                    target = ys
                 # same epilogue definition as the per-model path (XLA fuses
                 # it into the batched program here; see ops/pallas_score.py)
                 diff, scaled, tot_u, tot_s = _jnp_score(
-                    ys, recon, err_shift[i], err_scale[i]
+                    target, recon, err_shift[i], err_scale[i]
                 )
                 return recon, diff, scaled, tot_u, tot_s
 
@@ -223,7 +271,12 @@ class _Bucket:
 
 @dataclass
 class ScoreResult:
-    """Raw-space arrays for one request, sliced back to its true length."""
+    """Raw-space arrays for one request, sliced back to its true length.
+
+    ``offset`` is the sequence warm-up: output row i corresponds to input
+    row i + offset (0 for feedforward). ``model_input`` holds the FULL
+    request; ``to_frame`` trims it (and the index) to the output rows,
+    matching ``DiffBasedAnomalyDetector.anomaly``'s frame exactly."""
 
     tags: List[str]
     model_input: np.ndarray
@@ -232,11 +285,15 @@ class ScoreResult:
     scaled: np.ndarray
     total_unscaled: np.ndarray
     total_scaled: np.ndarray
+    offset: int = 0
 
     def to_frame(self, index=None):
+        n_out = len(self.model_output)
+        if index is not None:
+            index = index[self.offset :][:n_out]
         return assemble_anomaly_frame(
             self.tags,
-            self.model_input,
+            self.model_input[self.offset :][:n_out],
             self.model_output,
             self.diff,
             self.scaled,
@@ -254,6 +311,8 @@ class ModelBank:
         self._buckets: Dict[str, _Bucket] = {}
         self._index: Dict[str, Tuple[str, int]] = {}  # name -> (bucket_key, i)
         self._tags: Dict[str, List[str]] = {}
+        # name -> human-readable reason the model serves per-model instead
+        self.fallback: Dict[str, str] = {}
 
     # -------------------------- construction -------------------------- #
 
@@ -262,8 +321,8 @@ class ModelBank:
         bank = cls(**kwargs)
         for name, model in models.items():
             try:
-                entry = _extract_entry(name, model)
-            except Exception:
+                entry, reason = _extract_entry(name, model)
+            except Exception as exc:
                 # one malformed model must not abort bank construction for
                 # the whole collection (this runs at server startup and in
                 # /reload); the model still serves via the per-model path
@@ -272,14 +331,19 @@ class ModelBank:
                     name,
                     exc_info=True,
                 )
+                bank.fallback[name] = f"extraction error: {type(exc).__name__}: {exc}"
                 continue
             if entry is None:
-                logger.debug("Model %r is not bankable; per-model path", name)
+                logger.debug("Model %r not bankable (%s); per-model path", name, reason)
+                bank.fallback[name] = reason or "not bankable"
                 continue
             key = json.dumps(
                 [
+                    entry.registry_type,
                     entry.kind,
                     entry.n_features,
+                    entry.lookback,
+                    entry.target_offset,
                     entry.compute_dtype,
                     sorted(entry.factory_kwargs.items()),
                 ],
@@ -292,6 +356,9 @@ class ModelBank:
                     entry.n_features,
                     entry.factory_kwargs,
                     compute_dtype=entry.compute_dtype,
+                    registry_type=entry.registry_type,
+                    lookback=entry.lookback,
+                    target_offset=entry.target_offset,
                 )
             bank._index[name] = (key, len(bucket.names))
             bucket.add(entry)
@@ -307,7 +374,29 @@ class ModelBank:
                 len(bank._index),
                 len(bank._buckets),
             )
+        # coverage is an operator signal: at 10k models a DEBUG line per
+        # fallback is invisible — surface the aggregate loudly (and per
+        # model through /models; see views.list_models)
+        if bank.fallback:
+            logger.warning(
+                "Model bank: %d/%d model(s) NOT banked (per-model scoring "
+                "path): %s",
+                len(bank.fallback),
+                len(bank.fallback) + len(bank._index),
+                ", ".join(
+                    f"{n} ({r})" for n, r in sorted(bank.fallback.items())[:10]
+                )
+                + (" ..." if len(bank.fallback) > 10 else ""),
+            )
         return bank
+
+    def coverage(self) -> Dict[str, Any]:
+        """Operator-facing bank coverage summary."""
+        return {
+            "banked": len(self._index),
+            "fallback": dict(self.fallback),
+            "n_buckets": len(self._buckets),
+        }
 
     def __contains__(self, name: str) -> bool:
         return name in self._index
@@ -343,6 +432,7 @@ class ModelBank:
         for key, req_ids in by_bucket.items():
             bucket = self._buckets[key]
             F = bucket.n_features
+            off = bucket.offset
             rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
             for ri, X in zip(req_ids, rows):
                 if X.ndim != 2 or X.shape[1] != F:
@@ -352,11 +442,21 @@ class ModelBank:
                     )
                 if X.shape[0] == 0:
                     raise ValueError(f"Request for {requests[ri][0]!r}: empty input")
+                if X.shape[0] <= off:
+                    raise ValueError(
+                        f"Request for {requests[ri][0]!r}: need more than "
+                        f"{off} rows (sequence warm-up), got {X.shape[0]}"
+                    )
             # rows-per-call stays a power of two and never exceeds max_rows
+            # (but must always cover at least one window + one output row)
             T = min(
                 _next_pow2(max(x.shape[0] for x in rows)), _prev_pow2(self.max_rows)
             )
-            # chunk any request longer than one call
+            T = max(T, _next_pow2(off + 1))
+            # chunk any request longer than one call; sequence chunks
+            # OVERLAP by the warm-up so no output rows are lost at chunk
+            # boundaries (each chunk yields rows [start+off, start+T))
+            step = T - off
             chunks: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
             for ri, X in zip(req_ids, rows):
                 yv = requests[ri][2]
@@ -369,8 +469,10 @@ class ModelBank:
                             f"Request for {requests[ri][0]!r}: y shape {Y.shape} "
                             f"must match X shape {X.shape}"
                         )
-                for start in range(0, X.shape[0], T):
-                    chunks.append((ri, start, X[start : start + T], Y[start : start + T]))
+                for start in range(0, X.shape[0] - off, step):
+                    chunks.append(
+                        (ri, start, X[start : start + T], Y[start : start + T])
+                    )
             B = _next_pow2(len(chunks))
             Xb = np.zeros((B, T, F), np.float32)
             Yb = np.zeros((B, T, F), np.float32)
@@ -387,14 +489,19 @@ class ModelBank:
                 np.asarray(tot_u),
                 np.asarray(tot_s),
             )
-            # reassemble per-request (concatenate chunks in order)
+            # reassemble per-request: each chunk contributes its VALID
+            # output rows (rows computed from real, unpadded input)
             per_req: Dict[int, List[int]] = {}
-            for ci, (ri, _s, _x, _y) in enumerate(chunks):
+            valid: Dict[int, int] = {}
+            for ci, (ri, _s, xc, _y) in enumerate(chunks):
                 per_req.setdefault(ri, []).append(ci)
+                valid[ci] = xc.shape[0] - off
             for ri, cis in per_req.items():
                 name, X, _yv = requests[ri]
-                n = X.shape[0]
-                cat = lambda arr: np.concatenate([arr[ci] for ci in cis], axis=0)[:n]
+                n_out = X.shape[0] - off
+                cat = lambda arr: np.concatenate(
+                    [arr[ci][: valid[ci]] for ci in cis], axis=0
+                )[:n_out]
                 results[ri] = ScoreResult(
                     tags=self._tags[name],
                     model_input=np.asarray(X, np.float32),
@@ -403,6 +510,7 @@ class ModelBank:
                     scaled=cat(scaled),
                     total_unscaled=cat(tot_u),
                     total_scaled=cat(tot_s),
+                    offset=off,
                 )
         return results  # type: ignore[return-value]
 

@@ -56,12 +56,25 @@ def _bank_engine(request: web.Request):
 
 @routes.get("/gordo/v0/{project}/models")
 async def list_models(request: web.Request) -> web.Response:
-    return web.json_response(
-        {
-            "project": request.match_info["project"],
-            "models": _collection(request).names(),
+    body = {
+        "project": request.match_info["project"],
+        "models": _collection(request).names(),
+    }
+    bank = request.app.get("bank")
+    if bank is not None:
+        # operator-facing coverage: which models score through the HBM
+        # bank vs the per-model fallback path, and why (server/bank.py)
+        cov = bank.coverage()
+        body["bank"] = {
+            "banked": sorted(n for n in body["models"] if n in bank),
+            "fallback": {
+                n: cov["fallback"].get(n, "not bankable")
+                for n in body["models"]
+                if n not in bank
+            },
+            "n_buckets": cov["n_buckets"],
         }
-    )
+    return web.json_response(body)
 
 
 @routes.post("/gordo/v0/{project}/reload")

@@ -20,12 +20,15 @@ from gordo_components_tpu.models.transformers import JaxMinMaxScaler
 from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 
 
-def _make_det(Xv, scaler=None, **ae_kwargs):
-    kwargs = dict(epochs=2, batch_size=64)
-    kwargs.update(ae_kwargs)
-    ae = AutoEncoder(**kwargs)
-    base = Pipeline([("scale", scaler), ("model", ae)]) if scaler is not None else ae
-    det = DiffBasedAnomalyDetector(base_estimator=base)
+def _make_det(Xv, scaler=None, base=None, **ae_kwargs):
+    if base is None:
+        kwargs = dict(epochs=2, batch_size=64)
+        kwargs.update(ae_kwargs)
+        base = AutoEncoder(**kwargs)
+    est = (
+        Pipeline([("scale", scaler), ("model", base)]) if scaler is not None else base
+    )
+    det = DiffBasedAnomalyDetector(base_estimator=est)
     det.fit(Xv)
     return det
 
@@ -50,11 +53,12 @@ def test_bank_membership_and_buckets(fleet_models):
     )
     lstm.fit(np.random.RandomState(1).rand(60, 3).astype("float32"))
     bank = ModelBank.from_models({**models, "lstm": lstm})
-    assert len(bank) == 4  # lstm is not bankable
-    assert "lstm" not in bank
+    assert len(bank) == 5  # sequence models bank too
+    assert "lstm" in bank
     assert all(name in bank for name in models)
-    # 3-feature models share a bucket; the 5-feature model gets its own
-    assert bank.n_buckets == 2
+    # 3-feature ff models share a bucket; 5-feature ff and the lstm each
+    # get their own
+    assert bank.n_buckets == 3
 
 
 @pytest.mark.parametrize("name", ["plain", "jax-scaled", "sk-scaled", "wide"])
@@ -214,3 +218,79 @@ async def test_batching_engine_stop_resolves_pending(fleet_models):
     await engine.stop()
     with pytest.raises(asyncio.CancelledError):
         await task
+
+
+class TestSequenceBank:
+    """LSTM/conv/forecast detectors bank too (BASELINE.md config 5 over
+    the full zoo): banked scoring must be frame-identical to the per-model
+    ``.anomaly()`` path, including the warm-up offset alignment."""
+
+    @pytest.fixture(scope="class")
+    def seq_models(self):
+        from gordo_components_tpu.models import ConvAutoEncoder, LSTMForecast
+
+        rng = np.random.RandomState(2)
+        X = rng.rand(120, 3).astype("float32")
+        out = {}
+        out["lstm"] = _make_det(
+            X, base=LSTMAutoEncoder(lookback_window=6, epochs=2, batch_size=64)
+        )
+        out["lstm-scaled"] = _make_det(
+            X,
+            scaler=MinMaxScaler(),
+            base=LSTMAutoEncoder(lookback_window=6, epochs=2, batch_size=64),
+        )
+        out["forecast"] = _make_det(
+            X, base=LSTMForecast(lookback_window=6, epochs=2, batch_size=64)
+        )
+        out["conv"] = _make_det(
+            X, base=ConvAutoEncoder(lookback_window=16, epochs=2, batch_size=64)
+        )
+        return out, X
+
+    @pytest.mark.parametrize("name", ["lstm", "lstm-scaled", "forecast", "conv"])
+    def test_sequence_bank_matches_anomaly(self, seq_models, name):
+        models, X = seq_models
+        bank = ModelBank.from_models(models)
+        assert name in bank
+        idx = pd.date_range("2020-01-01", periods=40, freq="10min")
+        Xdf = pd.DataFrame(X[:40], columns=["t1", "t2", "t3"], index=idx)
+        got = bank.score(name, X[:40]).to_frame(index=idx)
+        expected = models[name].anomaly(Xdf)
+        pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_sequence_chunk_overlap_loses_no_rows(self, seq_models):
+        """Chunked long requests overlap by the warm-up: output length and
+        values match the unchunked per-model path."""
+        models, X = seq_models
+        bank = ModelBank.from_models(models, max_rows_per_call=32)
+        res = bank.score("lstm", X)  # 120 rows -> several 32-row chunks
+        assert len(res.model_output) == len(X) - 5  # offset = lookback-1
+        expected = models["lstm"].anomaly(X)
+        got = res.to_frame()
+        pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_sequence_too_short_request_raises(self, seq_models):
+        models, X = seq_models
+        bank = ModelBank.from_models(models)
+        with pytest.raises(ValueError, match="warm-up"):
+            bank.score("lstm", X[:5])  # 5 rows <= offset
+
+    def test_coverage_reports_fallback_reasons(self, seq_models):
+        models, X = seq_models
+        from sklearn.decomposition import PCA
+        from sklearn.pipeline import Pipeline as SkPipeline
+
+        from gordo_components_tpu.models import AutoEncoder
+
+        pca_det = DiffBasedAnomalyDetector(
+            base_estimator=SkPipeline(
+                [("pca", PCA(n_components=3)), ("model", AutoEncoder(epochs=1))]
+            )
+        )
+        pca_det.fit(X)
+        bank = ModelBank.from_models({**models, "pca": pca_det})
+        cov = bank.coverage()
+        assert cov["banked"] == len(models)
+        assert "pca" in cov["fallback"]
+        assert "non-affine" in cov["fallback"]["pca"]

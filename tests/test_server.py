@@ -53,6 +53,11 @@ async def test_list_models(artifact_dir):
         assert resp.status == 200
         body = await resp.json()
         assert body["models"] == ["machine-a", "machine-b"]
+        # bank coverage surfaced per model: machine-a (detector) banks,
+        # machine-b (bare estimator) falls back with a reason
+        assert body["bank"]["banked"] == ["machine-a"]
+        assert "machine-b" in body["bank"]["fallback"]
+        assert "DiffBasedAnomalyDetector" in body["bank"]["fallback"]["machine-b"]
 
 
 async def test_healthcheck_and_404(artifact_dir):
