@@ -46,14 +46,15 @@ _SCALER_PATHS = (
 )
 
 # AutoEncoder kwargs the fleet path honors with semantics identical to the
-# single-build path: FleetTrainer's own training knobs plus the feedforward
-# factory surface. Anything else (e.g. validation_split, loss overrides)
-# must take the single-build path rather than be silently dropped.
+# single-build path: FleetTrainer's own training knobs (including
+# validation_split, whose val-loss drives the per-member ES mask) plus the
+# feedforward factory surface. Anything else (e.g. loss overrides) must
+# take the single-build path rather than be silently dropped.
 _TRAINER_KEYS = frozenset(
     {
         "kind", "epochs", "batch_size", "learning_rate", "optimizer",
-        "early_stopping_patience", "early_stopping_min_delta", "seed",
-        "compute_dtype", "quantize_rows",
+        "early_stopping_patience", "early_stopping_min_delta",
+        "validation_split", "seed", "compute_dtype", "quantize_rows",
     }
 )
 _FACTORY_KEYS = frozenset(

@@ -105,6 +105,19 @@ class _BucketPrograms:
         self._vm_epoch = jax.vmap(masked_epoch)
         self.run_epoch = jax.jit(jax.vmap(masked_epoch), donate_argnums=(0,))
 
+        # per-member validation loss: one masked forward over all rows —
+        # the same global masked mean eval_fn computes batchwise in the
+        # single-model path (models/models.py), so fleet val-loss ES has
+        # identical semantics to BaseEstimator.fit's
+        from gordo_components_tpu.ops.losses import mse_loss
+
+        def member_val_loss(params, x, vmask):
+            pred = module.apply(params, x)
+            return mse_loss(pred, x, vmask)
+
+        self._vm_eval = jax.vmap(member_val_loss)
+        self.eval_stacked = jax.jit(self._vm_eval)
+
         @jax.jit
         def fit_error_scalers(params, X, mask):
             def one(p, x, m):
@@ -123,30 +136,47 @@ class _BucketPrograms:
         self.fit_error_scalers = fit_error_scalers
         self._chunks: Dict[Tuple, Any] = {}
 
-    def chunk_fn(self, K: int, es_enabled: bool, es_p0, delta):
-        """K-epoch device chunk with (optional) on-device early stopping."""
+    def chunk_fn(self, K: int, es_enabled: bool, es_p0, delta, use_val: bool = False):
+        """K-epoch device chunk with (optional) on-device early stopping,
+        monitoring validation loss when ``use_val`` (members without val
+        rows fall back to train loss, as BaseEstimator.fit effectively
+        does)."""
         # ES-off programs ignore p0/delta: normalize them out of the key
         # so trainers differing only in unused ES knobs share the compile
         key = (
-            (K, True, int(es_p0), float(delta)) if es_enabled else (K, False, 0, 0.0)
+            (K, True, int(es_p0), float(delta), bool(use_val))
+            if es_enabled
+            else (K, False, 0, 0.0, bool(use_val))
         )
         if key not in self._chunks:
             vm_epoch = self._vm_epoch
+            vm_eval = self._vm_eval
 
             @functools.partial(jax.jit, donate_argnums=(0,))
-            def run_chunk(carry, X, mask):
+            def run_chunk(carry, X, mask, val_mask):
                 # body closes over run_chunk's traced X/mask args — NOT
                 # outer device arrays, which jit would bake in as constants.
-                # Each epoch emits (loss, pre-epoch active) so the host can
-                # tell "was inactive" apart from "active but NaN loss".
+                # Each epoch emits (loss, val_loss, pre-epoch active) so the
+                # host can tell "was inactive" apart from "active but NaN
+                # loss".
+                def epoch_losses(st2, losses, act):
+                    """(train, val, monitored) for the finished epoch."""
+                    if not use_val:
+                        return losses, jnp.full_like(losses, jnp.nan), losses
+                    vals = vm_eval(st2.params, X, val_mask)
+                    vals = jnp.where(act > 0, vals, jnp.nan)
+                    has_val = jnp.sum(val_mask, axis=1) > 0
+                    return losses, vals, jnp.where(has_val, vals, losses)
+
                 if es_enabled:
 
                     def body(c, _):
                         st, act, bst, pat, bp, seeded = c
                         act_pre = act
                         st2, losses = vm_epoch(st, X, mask, act)
-                        improved = (losses < bst - delta) & (act > 0)
-                        bst = jnp.where(improved, losses, bst)
+                        losses, vals, monitored = epoch_losses(st2, losses, act)
+                        improved = (monitored < bst - delta) & (act > 0)
+                        bst = jnp.where(improved, monitored, bst)
                         # first epoch of a fresh run seeds best_params with
                         # the post-epoch params for EVERY member (even
                         # non-improving, e.g. NaN loss) — parity with the
@@ -165,6 +195,7 @@ class _BucketPrograms:
                         ).astype(jnp.float32)
                         return (st2, act, bst, pat, bp, jnp.float32(1.0)), (
                             losses,
+                            vals,
                             act_pre,
                         )
 
@@ -173,7 +204,8 @@ class _BucketPrograms:
                     def body(c, _):
                         st, act, bst, pat = c
                         st2, losses = vm_epoch(st, X, mask, act)
-                        return (st2, act, bst, pat), (losses, act)
+                        losses, vals, _ = epoch_losses(st2, losses, act)
+                        return (st2, act, bst, pat), (losses, vals, act)
 
                 return jax.lax.scan(body, carry, None, length=K)
 
@@ -295,6 +327,7 @@ class FleetTrainer:
         optimizer: str = "adam",
         early_stopping_patience: Optional[int] = None,
         early_stopping_min_delta: float = 0.0,
+        validation_split: float = 0.0,
         seed: int = 0,
         mesh=None,
         compute_dtype: str = "float32",
@@ -312,6 +345,11 @@ class FleetTrainer:
         self.optimizer = optimizer
         self.early_stopping_patience = early_stopping_patience
         self.early_stopping_min_delta = float(early_stopping_min_delta)
+        # per-member holdout: the LAST int(rows * split) rows of each
+        # member are excluded from training and scored after every epoch;
+        # when early stopping is on, val loss drives the ES mask (parity
+        # with BaseEstimator.fit's validation_split semantics)
+        self.validation_split = float(validation_split)
         self.seed = int(seed)
         self.mesh = mesh
         self.compute_dtype = compute_dtype
@@ -417,6 +455,36 @@ class FleetTrainer:
         Xd = jax.device_put(jnp.asarray(Xs), sharding)
         maskd = jax.device_put(jnp.asarray(masks), sharding)
 
+        # ---- per-member train/validation masks over the same padded
+        # buffer: the LAST int(rows*split) real rows of each member are
+        # holdout. Input/error scalers keep the FULL mask (the single-model
+        # pipeline's scaler also fits before the estimator's internal
+        # split). Members whose split floors to 0 val rows monitor train
+        # loss, like a single build with n_val == 0. ----
+        use_val = self.validation_split > 0.0
+        # mesh-padding dummy slots replicate real members CYCLICALLY
+        # (fleet_stack_pad uses i % n), so their masks must use the row
+        # count of the member whose data they actually hold
+        n_rows = np.array(
+            [arrays[names[i % M_real]].shape[0] for i in range(M)]
+        )
+        n_val = (n_rows * self.validation_split).astype(np.int64)
+        n_train = n_rows - n_val
+        has_val = n_val > 0
+        if use_val:
+            row_idx = np.arange(padded_rows)[None, :]
+            train_mask = (row_idx < n_train[:, None]).astype(np.float32)
+            vmask_np = (
+                (row_idx >= n_train[:, None]) & (row_idx < n_rows[:, None])
+            ).astype(np.float32)
+            train_maskd = jax.device_put(jnp.asarray(train_mask), sharding)
+            val_maskd = jax.device_put(jnp.asarray(vmask_np), sharding)
+        else:
+            train_maskd = maskd
+            val_maskd = jax.device_put(
+                jnp.zeros((M, padded_rows), jnp.float32), sharding
+            )
+
         # ---- per-member scalers, fitted on device (masked rows excluded
         # by writing NaNs, which the nan-aware fit ignores) ----
         scalers = _fit_scalers(Xd, maskd)
@@ -452,6 +520,7 @@ class FleetTrainer:
             dtype=np.int64,
         )
         histories: List[List[float]] = [[] for _ in range(M)]
+        histories_val: List[List[float]] = [[] for _ in range(M)]
 
         # best-params restore, matching BaseEstimator.fit: each member ends
         # on the params of its best epoch, not the epoch it stopped at
@@ -480,6 +549,7 @@ class FleetTrainer:
                     self.optimizer,
                     self.early_stopping_patience,
                     self.early_stopping_min_delta,
+                    self.validation_split,
                     self.seed,
                     int(mesh.shape[MODEL_AXIS]),
                     # sync width changes the ES decision engine (device f32
@@ -510,6 +580,9 @@ class FleetTrainer:
                     best = np.asarray(resumed["best"], np.float64)
                     patience = np.asarray(resumed["patience"], np.int64)
                     histories = [list(h) for h in resumed["histories"]]
+                    histories_val = [
+                        list(h) for h in resumed.get("histories_val", [[]] * M)
+                    ]
                     start_epoch = int(resumed["epoch"]) + 1
                     if es_enabled and not active.any():
                         # every member already early-stopped when preempted
@@ -534,6 +607,7 @@ class FleetTrainer:
                         dtype=np.int64,
                     )
                     histories = [[] for _ in range(M)]
+                    histories_val = [[] for _ in range(M)]
                     start_epoch = 0
 
         def save_checkpoint(epoch):
@@ -553,21 +627,24 @@ class FleetTrainer:
                     "best": best.tolist(),
                     "patience": patience.tolist(),
                     "histories": histories,
+                    "histories_val": histories_val,
                 },
             )
 
         epoch_times: List[float] = []
         sync = max(1, int(self.host_sync_every))
 
-        def after_epochs(first_epoch, losses_rows, active_rows):
+        def after_epochs(first_epoch, losses_rows, vals_rows, active_rows):
             """Host bookkeeping shared by both loop shapes: histories from
             (k, M) loss rows + pre-epoch active rows (a model that was
             active records its loss even if that loss is NaN — divergence
             must stay visible in the history), callback, checkpoint."""
-            for row, act_row in zip(losses_rows, active_rows):
+            for row, vrow, act_row in zip(losses_rows, vals_rows, active_rows):
                 for i in range(M):
                     if act_row[i] > 0:
                         histories[i].append(float(row[i]))
+                        if use_val and has_val[i]:
+                            histories_val[i].append(float(vrow[i]))
             last = first_epoch + len(losses_rows) - 1
             if self.epoch_callback is not None:
                 self.epoch_callback(
@@ -587,14 +664,25 @@ class FleetTrainer:
             for epoch in range(start_epoch, self.epochs):
                 te = time.time()
                 active_pre = active
-                states, losses = run_epoch(states, Xd, maskd, jnp.asarray(active))
+                states, losses = run_epoch(
+                    states, Xd, train_maskd, jnp.asarray(active)
+                )
                 losses = np.asarray(losses)
+                if use_val:
+                    vals = np.asarray(
+                        progs.eval_stacked(states.params, Xd, val_maskd)
+                    )
+                    vals = np.where(active_pre > 0, vals, np.nan)
+                    monitored = np.where(has_val, vals, losses)
+                else:
+                    vals = np.full_like(losses, np.nan)
+                    monitored = losses
                 epoch_times.append(time.time() - te)
                 if es_enabled:
-                    improved = (losses < best - self.early_stopping_min_delta) & (
+                    improved = (monitored < best - self.early_stopping_min_delta) & (
                         active > 0
                     )
-                    best = np.where(improved, losses, best)
+                    best = np.where(improved, monitored, best)
                     if best_params is None:
                         best_params = jax.tree.map(jnp.copy, states.params)
                     else:
@@ -612,7 +700,7 @@ class FleetTrainer:
                         (patience <= 0) & ~improved, 0.0, active
                     ).astype(np.float32)
                     active = after
-                after_epochs(epoch, [losses], [active_pre])
+                after_epochs(epoch, [losses], [vals], [active_pre])
                 if es_enabled and not active.any():
                     logger.info(
                         "All %d models early-stopped at epoch %d", M, epoch + 1
@@ -628,7 +716,7 @@ class FleetTrainer:
             def get_chunk_fn(K: int):
                 # carry WITHOUT best-params when ES is off: carrying an
                 # alias of st.params alongside st would break donation
-                return progs.chunk_fn(K, es_enabled, es_p0, delta)
+                return progs.chunk_fn(K, es_enabled, es_p0, delta, use_val=use_val)
 
             seeded = jnp.float32(0.0 if best_params is None else 1.0)
             if es_enabled and best_params is None:
@@ -645,8 +733,11 @@ class FleetTrainer:
             while epoch < self.epochs:
                 K = min(sync, self.epochs - epoch)
                 te = time.time()
-                carry, (losses_k, act_k) = get_chunk_fn(K)(carry, Xd, maskd)
+                carry, (losses_k, vals_k, act_k) = get_chunk_fn(K)(
+                    carry, Xd, train_maskd, val_maskd
+                )
                 losses_k = np.asarray(losses_k)  # (K, M)
+                vals_k = np.asarray(vals_k)  # (K, M) val losses (NaN when off)
                 act_k = np.asarray(act_k)  # (K, M) pre-epoch active masks
                 chunk_t = time.time() - te
                 epoch_times.extend([round(chunk_t / K, 4)] * K)
@@ -657,7 +748,7 @@ class FleetTrainer:
                 patience = np.asarray(carry[3], np.int64)
                 if es_enabled:
                     best_params = carry[4]  # (seeded flag rides at carry[5])
-                after_epochs(epoch, list(losses_k), list(act_k))
+                after_epochs(epoch, list(losses_k), list(vals_k), list(act_k))
                 epoch += K
                 if es_enabled and not active.any():
                     logger.info(
@@ -684,6 +775,9 @@ class FleetTrainer:
 
         out = {}
         for i, name in enumerate(names):  # drop dummy pads (i >= M_real)
+            history = {"loss": histories[i]}
+            if use_val and has_val[i]:
+                history["val_loss"] = histories_val[i]
             out[name] = FleetMemberModel(
                 name=name,
                 kind=self.kind,
@@ -698,7 +792,7 @@ class FleetTrainer:
                 error_scaler=ScalerParams(
                     shift=err_np.shift[i], scale=err_np.scale[i]
                 ),
-                history={"loss": histories[i]},
+                history=history,
                 tags=self._tags_map.get(name),
                 feature_thresholds=feat_thresh[i],
                 total_threshold=float(total_thresh[i]),

@@ -107,22 +107,30 @@ class TestExtractFleetable:
         assert extract_fleetable(cfg) is None
 
     def test_unsupported_ae_kwargs_not_fleetable(self):
-        """AE kwargs the trainer can't honor (validation_split, loss) must
-        force the single-build path instead of being silently dropped."""
-        for bad in ({"validation_split": 0.2}, {"loss": "mse"}):
-            cfg = {
+        """AE kwargs the trainer can't honor (loss overrides, DP) must
+        force the single-build path instead of being silently dropped —
+        while honored knobs like validation_split stay fleetable."""
+
+        def cfg(ae_kwargs):
+            return {
                 "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
                     "base_estimator": {
                         "sklearn.pipeline.Pipeline": {
                             "steps": [
                                 "sklearn.preprocessing.MinMaxScaler",
-                                {"gordo_components_tpu.models.AutoEncoder": bad},
+                                {"gordo_components_tpu.models.AutoEncoder": ae_kwargs},
                             ]
                         }
                     }
                 }
             }
-            assert extract_fleetable(cfg) is None
+
+        for bad in ({"loss": "mse"}, {"data_parallel": True}):
+            assert extract_fleetable(cfg(bad)) is None
+        # validation_split is honored by FleetTrainer (val-loss ES parity)
+        assert extract_fleetable(cfg({"validation_split": 0.2})) == {
+            "validation_split": 0.2
+        }
 
     def test_unscaled_pipeline_not_fleetable(self):
         """A pipeline without a scaler step must not be silently min-max

@@ -109,3 +109,115 @@ def test_chunked_checkpoint_resume(tmp_path):
         members
     )
     _assert_same_models(ref, got)
+
+
+class TestValidationSplit:
+    """validation_split in the fleet: per-member holdout rows, val loss
+    driving ES, val_loss histories — chunked and per-epoch paths agree."""
+
+    @pytest.mark.parametrize("sync", [2, 6])
+    def test_chunked_with_validation_matches_per_epoch(self, sync):
+        members = _members(rows=90)
+        common = dict(
+            epochs=6, batch_size=32, seed=3, validation_split=0.2,
+            early_stopping_patience=3,
+        )
+        per_epoch = FleetTrainer(host_sync_every=1, **common).fit(members)
+        chunked = FleetTrainer(host_sync_every=sync, **common).fit(members)
+        _assert_same_models(per_epoch, chunked)
+        for name in members:
+            np.testing.assert_allclose(
+                per_epoch[name].history["val_loss"],
+                chunked[name].history["val_loss"],
+                rtol=1e-5,
+                err_msg=f"{name} val_loss history",
+            )
+
+    def test_val_histories_present_and_aligned(self):
+        members = _members(rows=90)
+        out = FleetTrainer(
+            epochs=4, batch_size=32, seed=0, validation_split=0.2
+        ).fit(members)
+        for fm in out.values():
+            assert len(fm.history["val_loss"]) == len(fm.history["loss"]) == 4
+            assert np.isfinite(fm.history["val_loss"]).all()
+
+    def test_val_loss_drives_early_stopping(self):
+        """A member whose val rows diverge from its train rows must stop
+        early on val loss even while train loss keeps improving."""
+        rng = np.random.RandomState(0)
+        rows = 100
+        # train region: smooth sine; val region (last 20%): pure noise at a
+        # different scale -> val loss cannot keep improving
+        t = np.arange(rows)
+        X = (np.sin(0.2 * t)[:, None] * np.ones((1, 3))).astype("float32")
+        X[80:] = 5.0 * rng.randn(20, 3).astype("float32")
+        members = {"diverge": X}
+        trainer = FleetTrainer(
+            epochs=60, batch_size=32, seed=0, validation_split=0.2,
+            early_stopping_patience=3,
+        )
+        out = trainer.fit(members)
+        # stopped well before the epoch budget
+        assert len(out["diverge"].history["loss"]) < 60
+
+    def test_members_without_val_rows_monitor_train_loss(self):
+        """split flooring to 0 val rows (tiny member) must behave like a
+        single build with n_val == 0: no val_loss key, train-loss ES."""
+        members = {"tiny": np.random.RandomState(0).rand(4, 3).astype("float32")}
+        out = FleetTrainer(
+            epochs=3, batch_size=32, seed=0, validation_split=0.1
+        ).fit(members)  # int(4 * 0.1) == 0 val rows
+        assert "val_loss" not in out["tiny"].history
+        assert len(out["tiny"].history["loss"]) == 3
+
+    def test_fleet_val_matches_single_model_semantics(self):
+        """Fleet val-loss values match a BaseEstimator fit with the same
+        split on the same (scaled) data to reasonable tolerance."""
+        import jax.numpy as jnp
+
+        from gordo_components_tpu.models import AutoEncoder
+        from gordo_components_tpu.ops.scaler import fit_minmax, scaler_transform
+
+        members = _members(n=1, rows=90)
+        X = members["m-0"]
+        out = FleetTrainer(
+            epochs=5, batch_size=32, seed=0, validation_split=0.2
+        ).fit(members)
+        # reproduce the fleet's preprocessing: min-max scale on ALL rows
+        Xs = np.asarray(scaler_transform(fit_minmax(jnp.asarray(X)), jnp.asarray(X)))
+        single = AutoEncoder(
+            epochs=5, batch_size=32, seed=0, validation_split=0.2
+        ).fit(Xs)
+        # different rng streams -> statistically close, not identical
+        fleet_final = out["m-0"].history["val_loss"][-1]
+        single_final = single.history["val_loss"][-1]
+        assert abs(fleet_final - single_final) / single_final < 0.5
+
+    def test_mesh_pad_dummies_mirror_real_members(self):
+        """Dummy mesh-padding slots replicate real members cyclically;
+        their train/val masks must use the replicated member's row count,
+        or their ES dynamics diverge and keep the bucket training after
+        every real member stopped."""
+        rng = np.random.RandomState(4)
+        t70, t90 = np.arange(70), np.arange(90)
+        members = {
+            # same bucket: 70 and 90 rows both quantize to 96 with bs=32
+            "a": (np.sin(0.2 * t70)[:, None] * np.ones((1, 3))
+                  + 0.01 * rng.randn(70, 3)).astype("float32"),
+            "b": (np.sin(0.2 * t90)[:, None] * np.ones((1, 3))
+                  + 0.01 * rng.randn(90, 3)).astype("float32"),
+        }
+        trainer = FleetTrainer(
+            epochs=40, batch_size=32, seed=0, learning_rate=0.05,
+            validation_split=0.2, early_stopping_patience=2,
+            early_stopping_min_delta=1e-3,
+        )
+        out = trainer.fit(members)  # M padded to 8 on the virtual mesh
+        assert len(trainer.last_stats["buckets"]) == 1
+        bucket = trainer.last_stats["buckets"][0]
+        real_epochs = max(len(fm.history["loss"]) for fm in out.values())
+        assert real_epochs < 40  # ES actually fired
+        # the epoch loop stopped when the REAL members (and their exact
+        # dummy mirrors) stopped — no extra epochs from diverged dummies
+        assert len(bucket["epoch_seconds"]) == real_epochs
