@@ -2,6 +2,11 @@
 unverified — SURVEY.md §2)."""
 
 from gordo_components_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_components_tpu.dataset.data_provider.datalake import (
+    DataLakeProvider,
+    IrocReader,
+    NcsReader,
+)
 from gordo_components_tpu.dataset.data_provider.providers import (
     FileSystemProvider,
     InfluxDataProvider,
@@ -13,4 +18,7 @@ __all__ = [
     "RandomDataProvider",
     "InfluxDataProvider",
     "FileSystemProvider",
+    "DataLakeProvider",
+    "NcsReader",
+    "IrocReader",
 ]

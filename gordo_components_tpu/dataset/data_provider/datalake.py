@@ -1,0 +1,252 @@
+"""Offline Data-Lake-style providers: path-convention readers over a
+mounted file tree.
+
+Reference parity (SURVEY.md §2 "dataset.data_provider", unverified): the
+reference's ``DataLakeProvider`` authenticates to Azure Data Lake Gen1
+(interactive device-code or service-principal ``dl_service_auth_str``) and
+dispatches per-tag reads to path-convention readers — ``NcsReader``
+(per-tag per-year files under Norwegian-Continental-Shelf directory
+conventions) and ``IrocReader`` (facility CSV dumps). The cloud SDK is not
+available in this environment, so the store is abstracted to a *mounted*
+directory tree (``store_path``): deployments mount the lake (blobfuse,
+NFS, rsync'd snapshot, ...) and the path conventions below are preserved.
+Auth kwargs are accepted for config compatibility and recorded in
+metadata, but no network auth is performed.
+
+Offline layout (documented dialect; create with plain pandas):
+
+    <store_path>/<asset>/<TAG>/<TAG>_<year>.csv      NCS yearly CSV
+    <store_path>/<asset>/<TAG>/<TAG>_<year>.parquet  NCS yearly parquet
+    <store_path>/<asset>/<file>.csv                  IROC facility dump
+
+- NCS yearly CSV: semicolon-separated, headerless rows
+  ``tag;value;timestamp`` (the reference's NCS file dialect).
+- NCS yearly parquet: pandas frame with a DatetimeIndex and a single
+  value column.
+- IROC facility CSV: comma-separated WITH header ``tag,timestamp,value``;
+  one file holds many tags.
+"""
+
+import glob
+import logging
+import os
+from typing import Dict, Iterable, List, Optional
+
+import pandas as pd
+
+from gordo_components_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_components_tpu.dataset.sensor_tag import SensorTag
+from gordo_components_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+def _asset_dir(store_path: str, asset_paths: Optional[Dict[str, str]], tag: SensorTag) -> str:
+    """Asset -> directory mapping; identity (asset name as subdir) unless
+    overridden, mirroring the reference's asset->lake-path table."""
+    asset = tag.asset or ""
+    rel = (asset_paths or {}).get(asset, asset)
+    return os.path.join(store_path, rel)
+
+
+class NcsReader(GordoBaseDataProvider):
+    """Per-tag per-year files: ``<store>/<asset>/<TAG>/<TAG>_<year>.csv``
+    (or ``.parquet``). Years absent from the range are simply skipped —
+    sensors come and go — but a tag with NO files at all is an error."""
+
+    @capture_args
+    def __init__(
+        self,
+        store_path: str,
+        asset_paths: Optional[Dict[str, str]] = None,
+        value_name: str = "Value",
+    ):
+        self.store_path = store_path
+        self.asset_paths = asset_paths
+        self.value_name = value_name
+
+    def _tag_dir(self, tag: SensorTag) -> str:
+        return os.path.join(_asset_dir(self.store_path, self.asset_paths, tag), tag.name)
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return os.path.isdir(self._tag_dir(tag))
+
+    def _read_year(self, tag: SensorTag, year: int) -> Optional[pd.Series]:
+        stem = os.path.join(self._tag_dir(tag), f"{tag.name}_{year}")
+        if os.path.exists(stem + ".parquet"):
+            df = pd.read_parquet(stem + ".parquet")
+            col = self.value_name if self.value_name in df.columns else df.columns[0]
+            idx = pd.to_datetime(df.index, utc=True)
+            return pd.Series(df[col].values, index=idx)
+        if os.path.exists(stem + ".csv"):
+            df = pd.read_csv(
+                stem + ".csv",
+                sep=";",
+                header=None,
+                names=["tag", "value", "timestamp"],
+            )
+            idx = pd.to_datetime(df["timestamp"], utc=True)
+            return pd.Series(df["value"].values, index=pd.DatetimeIndex(idx))
+        return None
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        if from_ts >= to_ts:
+            raise ValueError(f"from_ts {from_ts} must precede to_ts {to_ts}")
+        for tag in tag_list:
+            if not self.can_handle_tag(tag):
+                raise FileNotFoundError(
+                    f"No NCS directory for tag {tag.name!r} "
+                    f"(expected {self._tag_dir(tag)!r})"
+                )
+            years = range(from_ts.year, to_ts.year + 1)
+            parts = [self._read_year(tag, y) for y in years]
+            parts = [p for p in parts if p is not None]
+            if not parts:
+                logger.warning(
+                    "Tag %r has no files in years %d..%d",
+                    tag.name, from_ts.year, to_ts.year,
+                )
+                yield pd.Series(dtype=float, name=tag.name)
+                continue
+            series = pd.concat(parts).sort_index()
+            series = series[(series.index >= from_ts) & (series.index < to_ts)]
+            series.name = tag.name
+            if dry_run:
+                logger.info("dry_run: %s -> %d rows", tag.name, len(series))
+            yield series
+
+
+class IrocReader(GordoBaseDataProvider):
+    """Facility CSV dumps: every ``*.csv`` directly under the asset dir,
+    comma-separated with header ``tag,timestamp,value``; one file holds
+    many tags (the reference's IROC shape)."""
+
+    @capture_args
+    def __init__(self, store_path: str, asset_paths: Optional[Dict[str, str]] = None):
+        self.store_path = store_path
+        self.asset_paths = asset_paths
+
+    def _asset_files(self, tag: SensorTag) -> List[str]:
+        return sorted(
+            glob.glob(
+                os.path.join(_asset_dir(self.store_path, self.asset_paths, tag), "*.csv")
+            )
+        )
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return bool(self._asset_files(tag))
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        if from_ts >= to_ts:
+            raise ValueError(f"from_ts {from_ts} must precede to_ts {to_ts}")
+        # read each facility file once, not once per tag
+        frames: Dict[str, pd.DataFrame] = {}
+        for tag in tag_list:
+            for path in self._asset_files(tag):
+                if path not in frames:
+                    frames[path] = pd.read_csv(path)
+        for tag in tag_list:
+            paths = self._asset_files(tag)
+            if not paths:
+                raise FileNotFoundError(
+                    f"No IROC files for tag {tag.name!r} under "
+                    f"{_asset_dir(self.store_path, self.asset_paths, tag)!r}"
+                )
+            rows = [
+                frames[p][frames[p]["tag"] == tag.name] for p in paths
+            ]
+            df = pd.concat(rows)
+            if df.empty:
+                logger.warning("Tag %r not present in IROC files %s", tag.name, paths)
+                yield pd.Series(dtype=float, name=tag.name)
+                continue
+            idx = pd.DatetimeIndex(pd.to_datetime(df["timestamp"], utc=True))
+            series = pd.Series(df["value"].values, index=idx).sort_index()
+            series = series[(series.index >= from_ts) & (series.index < to_ts)]
+            series.name = tag.name
+            yield series
+
+
+class DataLakeProvider(GordoBaseDataProvider):
+    """Dispatching facade over the lake readers (reference:
+    ``DataLakeProvider`` with sub-readers selected per tag).
+
+    ``interactive`` / ``dl_service_auth_str`` are accepted for config
+    compatibility with reference-era YAML and recorded in metadata; they
+    perform no network auth here — mount the lake at ``store_path``.
+    """
+
+    @capture_args
+    def __init__(
+        self,
+        store_path: str,
+        asset_paths: Optional[Dict[str, str]] = None,
+        interactive: bool = False,
+        dl_service_auth_str: Optional[str] = None,
+        value_name: str = "Value",
+    ):
+        self.store_path = store_path
+        self.asset_paths = asset_paths
+        if interactive or dl_service_auth_str:
+            logger.info(
+                "DataLakeProvider: auth options are recorded but unused — "
+                "this offline provider reads the lake mounted at %r",
+                store_path,
+            )
+        self.readers: List[GordoBaseDataProvider] = [
+            NcsReader(store_path, asset_paths=asset_paths, value_name=value_name),
+            IrocReader(store_path, asset_paths=asset_paths),
+        ]
+
+    def _reader_for(self, tag: SensorTag) -> Optional[GordoBaseDataProvider]:
+        for reader in self.readers:
+            if reader.can_handle_tag(tag):
+                return reader
+        return None
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return self._reader_for(tag) is not None
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        # group per reader to keep per-file reads batched, then restore
+        # the caller's tag order POSITIONALLY — readers yield in tag-list
+        # order, and keying by series name would collapse two same-named
+        # tags on different assets into one
+        readers = []
+        for tag in tag_list:
+            reader = self._reader_for(tag)
+            if reader is None:
+                raise FileNotFoundError(
+                    f"No lake reader can handle tag {tag.name!r} "
+                    f"(asset {tag.asset!r}) under {self.store_path!r}"
+                )
+            readers.append(reader)
+        results: List[Optional[pd.Series]] = [None] * len(tag_list)
+        for robj in self.readers:
+            positions = [i for i, r in enumerate(readers) if r is robj]
+            if not positions:
+                continue
+            tags = [tag_list[i] for i in positions]
+            for i, series in zip(
+                positions, robj.load_series(from_ts, to_ts, tags, dry_run)
+            ):
+                results[i] = series
+        yield from results
