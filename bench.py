@@ -116,7 +116,7 @@ def _count_params(model_type: str, kind: str, n_features: int, sample_shape, **k
 
 
 def bench_fleet(
-    n_models=256, rows=1440, n_features=10, epochs=5, batch_size=128,
+    n_models=1024, rows=1440, n_features=10, epochs=5, batch_size=128,
     host_sync_every=5,
 ):
     """Config 3 — many-model fleet training: models/hour/chip + FLOP/s.

@@ -139,6 +139,8 @@ def build_fleet(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
     distributed: bool = False,
+    state_dir: Optional[str] = None,
+    gang_id: Optional[str] = None,
 ) -> Dict[str, str]:
     """Build every machine; returns name -> artifact dir.
 
@@ -148,10 +150,13 @@ def build_fleet(
     ``checkpoint_dir`` enables mid-training preemption recovery for the
     fleet groups (parallel/checkpoint.py): a restarted gang resumes its
     interrupted epoch loop instead of retraining from scratch.
+    ``state_dir`` enables gang heartbeats (workflow/gang_state.py): phase
+    and per-epoch progress on a shared volume for watchman to aggregate.
     """
     results: Dict[str, str] = {}
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
     trainer_mesh = None
+    dist_ok = False
 
     if distributed:
         # pod-scale gang: every host runs this same function; each owns a
@@ -162,7 +167,8 @@ def build_fleet(
             partition_members,
         )
 
-        if initialize_distributed():
+        dist_ok = initialize_distributed()
+        if dist_ok:
             # members are partitioned per host, so each host's member stack
             # is host-local and differently shaped: the trainer mesh must
             # span only THIS host's devices. A global mesh (jax.devices()
@@ -196,30 +202,61 @@ def build_fleet(
             )
         machines = [m for m in machines if m.name in owned]
 
-    for machine in machines:
-        ae_kwargs = extract_fleetable(machine.model)
-        if ae_kwargs is None:
-            logger.info("Machine %s: bespoke config, single-build path", machine.name)
-            results[machine.name] = provide_saved_model(
-                machine.name,
-                machine.model,
-                machine.dataset,
-                machine.metadata,
-                output_dir=os.path.join(output_dir, machine.name),
-                model_register_dir=model_register_dir,
-                replace_cache=replace_cache,
-            )
-        else:
-            fleet_groups.setdefault(_group_key(ae_kwargs), []).append(
-                (machine, ae_kwargs)
-            )
+    heartbeat = None
+    if state_dir:
+        from gordo_components_tpu.workflow.gang_state import GangHeartbeat
 
-    for _, group in fleet_groups.items():
-        _build_fleet_group(
-            group, output_dir, model_register_dir, replace_cache, results,
-            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            mesh=trainer_mesh,
+        # created AFTER member partitioning: n_machines reflects this
+        # host's slice, and in a multi-host gang the template-pinned
+        # GANG_ID is suffixed per host so peers don't clobber each other's
+        # heartbeat (one host finishing must not mask the rest)
+        if gang_id and dist_ok:
+            import jax
+
+            gang_id = f"{gang_id}-host{jax.process_index()}"
+        heartbeat = GangHeartbeat(state_dir, gang_id)
+        heartbeat.update(
+            phase="starting", n_machines=len(machines), built=0,
+            distributed=bool(distributed),
         )
+
+    try:
+        for machine in machines:
+            ae_kwargs = extract_fleetable(machine.model)
+            if ae_kwargs is None:
+                logger.info(
+                    "Machine %s: bespoke config, single-build path", machine.name
+                )
+                results[machine.name] = provide_saved_model(
+                    machine.name,
+                    machine.model,
+                    machine.dataset,
+                    machine.metadata,
+                    output_dir=os.path.join(output_dir, machine.name),
+                    model_register_dir=model_register_dir,
+                    replace_cache=replace_cache,
+                )
+                if heartbeat is not None:
+                    heartbeat.update(phase="building", built=len(results))
+            else:
+                fleet_groups.setdefault(_group_key(ae_kwargs), []).append(
+                    (machine, ae_kwargs)
+                )
+
+        for _, group in fleet_groups.items():
+            _build_fleet_group(
+                group, output_dir, model_register_dir, replace_cache, results,
+                checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+                mesh=trainer_mesh, heartbeat=heartbeat,
+            )
+    except BaseException as exc:
+        if heartbeat is not None:
+            heartbeat.finish(
+                "failed", built=len(results), error=f"{type(exc).__name__}: {exc}"
+            )
+        raise
+    if heartbeat is not None:
+        heartbeat.finish("done", built=len(results))
     return results
 
 
@@ -232,6 +269,7 @@ def _build_fleet_group(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
     mesh=None,
+    heartbeat=None,
 ) -> None:
     ae_kwargs = copy.deepcopy(group[0][1])
 
@@ -251,6 +289,8 @@ def _build_fleet_group(
         return
 
     # host-side data loading (the IO hot loop, SURVEY.md §3.1)
+    if heartbeat is not None:
+        heartbeat.update(phase="loading", group_members=len(pending))
     t0 = time.time()
     member_data: Dict[str, np.ndarray] = {}
     datasets_meta: Dict[str, Dict] = {}
@@ -264,9 +304,20 @@ def _build_fleet_group(
     trainer_kwargs = {
         k: ae_kwargs.pop(k) for k in _TRAINER_KEYS if k in ae_kwargs
     }
+    epoch_cb = None
+    if heartbeat is not None:
+
+        def epoch_cb(info):
+            heartbeat.update(
+                phase="training",
+                bucket=[int(info["n_features"]), int(info["padded_rows"])],
+                epoch=int(info["epoch"]),
+                n_active=int(info["n_active"]),
+            )
+
     trainer = FleetTrainer(
         checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-        mesh=mesh, **trainer_kwargs, **ae_kwargs,
+        mesh=mesh, epoch_callback=epoch_cb, **trainer_kwargs, **ae_kwargs,
     )
     t1 = time.time()
     from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
@@ -308,3 +359,5 @@ def _build_fleet_group(
             serializer.dump(det, mirror, metadata=metadata)
         results[name] = dest
         logger.info("Machine %s: fleet-built -> %s", name, dest)
+    if heartbeat is not None:
+        heartbeat.update(phase="building", built=len(results))

@@ -101,8 +101,13 @@ def build(name, model_config, data_config, metadata, output_dir, model_register_
 @click.option("--distributed", is_flag=True, envvar="GORDO_DISTRIBUTED",
               help="Multi-host gang: init jax.distributed and build only "
                    "this host's member slice")
+@click.option("--state-dir", envvar="GANG_STATE_DIR", default=None,
+              help="Publish gang heartbeats (phase/progress) here for "
+                   "watchman to aggregate")
+@click.option("--gang-id", envvar="GANG_ID", default=None,
+              help="Heartbeat identity (default: hostname-pid)")
 def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_dir,
-                    checkpoint_every, distributed):
+                    checkpoint_every, distributed, state_dir, gang_id):
     """Build a gang of machines in one process (TPU fleet engine)."""
     from gordo_components_tpu.builder.fleet_build import build_fleet
     from gordo_components_tpu.workflow.config import Machine
@@ -132,7 +137,7 @@ def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_di
         results = build_fleet(
             machines, output_dir, model_register_dir=model_register_dir,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            distributed=distributed,
+            distributed=distributed, state_dir=state_dir, gang_id=gang_id,
         )
     except Exception as exc:
         click.echo(f"Fleet build failed: {exc}", err=True)
@@ -170,14 +175,19 @@ def run_server_cmd(model_dir, host, port):
 @click.option("--server-base-url", envvar="SERVER_BASE_URL", required=True)
 @click.option("--targets", envvar="TARGET_NAMES", default=None,
               help="JSON list; discovered from the server when omitted")
+@click.option("--gang-state-dir", envvar="GANG_STATE_DIR", default=None,
+              help="Aggregate builder-gang heartbeats from this directory")
 @click.option("--host", default="0.0.0.0")
 @click.option("--port", default=5556, type=int)
-def run_watchman_cmd(project, server_base_url, targets, host, port):
+def run_watchman_cmd(project, server_base_url, targets, gang_state_dir, host, port):
     """Fleet health aggregation service."""
     from gordo_components_tpu.watchman import run_watchman
 
     target_list = json.loads(targets) if targets else None
-    run_watchman(project, server_base_url, target_list, host=host, port=port)
+    run_watchman(
+        project, server_base_url, target_list, host=host, port=port,
+        gang_state_dir=gang_state_dir,
+    )
 
 
 @gordo.group("client")

@@ -132,12 +132,17 @@ class IrocReader(GordoBaseDataProvider):
         self.store_path = store_path
         self.asset_paths = asset_paths
 
-    def _asset_files(self, tag: SensorTag) -> List[str]:
-        return sorted(
-            glob.glob(
-                os.path.join(_asset_dir(self.store_path, self.asset_paths, tag), "*.csv")
-            )
-        )
+    def _asset_files(self, tag: SensorTag, cache: Optional[Dict[str, List[str]]] = None) -> List[str]:
+        # directory listings are remote round-trips on the network mounts
+        # this provider targets: within one load_series call each asset
+        # dir is globbed once (``cache``), not once per tag per loop
+        d = _asset_dir(self.store_path, self.asset_paths, tag)
+        if cache is not None and d in cache:
+            return cache[d]
+        out = sorted(glob.glob(os.path.join(d, "*.csv")))
+        if cache is not None:
+            cache[d] = out
+        return out
 
     def can_handle_tag(self, tag: SensorTag) -> bool:
         return bool(self._asset_files(tag))
@@ -152,13 +157,14 @@ class IrocReader(GordoBaseDataProvider):
         if from_ts >= to_ts:
             raise ValueError(f"from_ts {from_ts} must precede to_ts {to_ts}")
         # read each facility file once, not once per tag
+        dir_cache: Dict[str, List[str]] = {}
         frames: Dict[str, pd.DataFrame] = {}
         for tag in tag_list:
-            for path in self._asset_files(tag):
+            for path in self._asset_files(tag, dir_cache):
                 if path not in frames:
                     frames[path] = pd.read_csv(path)
         for tag in tag_list:
-            paths = self._asset_files(tag)
+            paths = self._asset_files(tag, dir_cache)
             if not paths:
                 raise FileNotFoundError(
                     f"No IROC files for tag {tag.name!r} under "

@@ -33,12 +33,19 @@ class WatchmanState:
         targets: Optional[List[str]] = None,
         refresh_interval: float = 30.0,
         parallelism: int = 20,
+        gang_state_dir: Optional[str] = None,
+        gang_stale_after: float = 120.0,
     ):
         self.project = project
         self.base_url = base_url.rstrip("/")
         self.targets = targets
         self.refresh_interval = refresh_interval
         self.parallelism = parallelism
+        # builder-side failure detection: aggregate gang heartbeats from
+        # the shared state volume (workflow/gang_state.py) so a stalled or
+        # failed TPU gang is visible next to serving health
+        self.gang_state_dir = gang_state_dir
+        self.gang_stale_after = gang_stale_after
         self._cache: Optional[Dict[str, Any]] = None
         self._cache_time = 0.0
         self._lock = asyncio.Lock()
@@ -91,6 +98,16 @@ class WatchmanState:
                 "gordo-watchman-version": __version__,
                 "endpoints": list(endpoints),
             }
+            if self.gang_state_dir:
+                from gordo_components_tpu.workflow.gang_state import read_gang_states
+
+                gangs = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    read_gang_states,
+                    self.gang_state_dir,
+                    self.gang_stale_after,
+                )
+                self._cache["gangs"] = gangs
             self._cache_time = now
             return self._cache
 
@@ -100,8 +117,12 @@ def build_watchman_app(
     base_url: str,
     targets: Optional[List[str]] = None,
     refresh_interval: float = 30.0,
+    gang_state_dir: Optional[str] = None,
 ) -> web.Application:
-    state = WatchmanState(project, base_url, targets, refresh_interval)
+    state = WatchmanState(
+        project, base_url, targets, refresh_interval,
+        gang_state_dir=gang_state_dir,
+    )
     app = web.Application()
     app["state"] = state
 
@@ -123,9 +144,13 @@ def run_watchman(
     host: str = "0.0.0.0",
     port: int = 5556,
     refresh_interval: float = 30.0,
+    gang_state_dir: Optional[str] = None,
 ) -> None:
     web.run_app(
-        build_watchman_app(project, base_url, targets, refresh_interval),
+        build_watchman_app(
+            project, base_url, targets, refresh_interval,
+            gang_state_dir=gang_state_dir,
+        ),
         host=host,
         port=port,
     )

@@ -1,0 +1,130 @@
+"""Gang heartbeat protocol: builder-side failure detection for watchman
+(SURVEY.md §5 "Failure detection" — the reference delegates this to the
+platform; the TPU gang publishes its own progress)."""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from gordo_components_tpu.workflow.gang_state import (
+    GangHeartbeat,
+    read_gang_states,
+)
+
+
+def test_heartbeat_write_and_read(tmp_path):
+    hb = GangHeartbeat(str(tmp_path), gang_id="gang-1")
+    hb.update(phase="training", epoch=3, n_active=10)
+    states = read_gang_states(str(tmp_path))
+    assert len(states) == 1
+    s = states[0]
+    assert s["gang_id"] == "gang-1"
+    assert s["phase"] == "training"
+    assert s["epoch"] == 3
+    assert not s["stale"]
+
+
+def test_fields_accumulate_across_updates(tmp_path):
+    hb = GangHeartbeat(str(tmp_path), gang_id="g")
+    hb.update(phase="loading", n_machines=5)
+    hb.update(phase="training", epoch=0)
+    (s,) = read_gang_states(str(tmp_path))
+    assert s["n_machines"] == 5  # earlier field preserved
+    assert s["phase"] == "training"
+
+
+def test_stale_detection(tmp_path):
+    hb = GangHeartbeat(str(tmp_path), gang_id="hung")
+    hb.update(phase="training")
+    # rewrite the file with an old timestamp to simulate a hung gang
+    with open(hb.path) as f:
+        state = json.load(f)
+    state["ts"] = time.time() - 600
+    with open(hb.path, "w") as f:
+        json.dump(state, f)
+    (s,) = read_gang_states(str(tmp_path), stale_after=120)
+    assert s["stale"]
+    # finished gangs are never stale, however old
+    state["phase"] = "done"
+    with open(hb.path, "w") as f:
+        json.dump(state, f)
+    (s,) = read_gang_states(str(tmp_path), stale_after=120)
+    assert not s["stale"]
+
+
+def test_unreadable_file_skipped(tmp_path):
+    GangHeartbeat(str(tmp_path), gang_id="ok").update(phase="done")
+    (tmp_path / "torn.json").write_text("{not json")
+    states = read_gang_states(str(tmp_path))
+    assert [s["gang_id"] for s in states] == ["ok"]
+
+
+def test_build_fleet_publishes_heartbeats(tmp_path):
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.workflow.config import Machine
+
+    machines = [
+        Machine(
+            name=f"m-{i}",
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00Z",
+                "train_end_date": "2020-01-01T06:00:00Z",
+                "tag_list": ["a", "b"],
+            },
+        )
+        for i in range(2)
+    ]
+    state_dir = tmp_path / "state"
+    build_fleet(
+        machines, str(tmp_path / "out"), state_dir=str(state_dir), gang_id="g-0"
+    )
+    (s,) = read_gang_states(str(state_dir))
+    assert s["gang_id"] == "g-0"
+    assert s["phase"] == "done"
+    assert s["built"] == 2
+    assert s["epoch"] >= 0  # per-epoch callback ran
+
+
+def test_build_fleet_failure_marks_heartbeat(tmp_path):
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.workflow.config import Machine
+
+    machines = [
+        Machine(name="bad", dataset={"type": "NoSuchDataset"})
+    ]
+    state_dir = tmp_path / "state"
+    try:
+        build_fleet(machines, str(tmp_path / "out"), state_dir=str(state_dir), gang_id="g-f")
+    except Exception:
+        pass
+    (s,) = read_gang_states(str(state_dir))
+    assert s["phase"] == "failed"
+    assert "error" in s
+
+
+async def test_watchman_serves_gang_states(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu.watchman.server import build_watchman_app
+
+    hb = GangHeartbeat(str(tmp_path), gang_id="gang-9")
+    hb.update(phase="training", epoch=7)
+    app = build_watchman_app(
+        "proj",
+        "http://127.0.0.1:1",  # unreachable: discovery degrades gracefully
+        targets=[],
+        gang_state_dir=str(tmp_path),
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.get("/")
+        body = await resp.json()
+        assert body["project_name"] == "proj"
+        assert body["gangs"][0]["gang_id"] == "gang-9"
+        assert body["gangs"][0]["epoch"] == 7
+    finally:
+        await client.close()
