@@ -228,6 +228,61 @@ def bench_sequence_models(rows=1440, n_features=10, epochs=5, batch_size=128):
     return out
 
 
+def bench_checkpoint_overhead(n_models=256, rows=1440, n_features=10, epochs=5):
+    """Preemption-checkpoint cost at fleet scale: wall-time ratio of a
+    checkpointed fit (key content-hash of every member + one orbax save
+    per epoch) vs the plain fit. Quantifies SURVEY §5 checkpoint/resume
+    overhead so operators can pick checkpoint_every."""
+    import shutil
+    import tempfile
+
+    from gordo_components_tpu.parallel import FleetTrainer
+
+    members = _synth_fleet(n_models, rows, n_features)
+    config = dict(
+        kind="feedforward_hourglass", epochs=epochs, batch_size=128,
+        compute_dtype="bfloat16",
+    )
+    FleetTrainer(**config).fit(members)  # warm the programs
+    t0 = time.time()
+    FleetTrainer(**config).fit(members)
+    plain = time.time() - t0
+
+    # warm orbax imports/registry once, with a tiny fit — checkpointing
+    # adds no XLA program, so the plain warm fit above already compiled
+    # everything the timed runs execute
+    warm_dir = tempfile.mkdtemp(prefix="bench-ckpt-warm-")
+    try:
+        FleetTrainer(
+            checkpoint_dir=warm_dir, checkpoint_every=1,
+            kind=config["kind"], epochs=2, batch_size=128,
+            compute_dtype=config["compute_dtype"],
+        ).fit({"warm": next(iter(members.values()))})
+    finally:
+        shutil.rmtree(warm_dir, ignore_errors=True)
+
+    def timed_ckpt(every: int) -> float:
+        ckpt_dir = tempfile.mkdtemp(prefix="bench-ckpt-")
+        try:
+            t0 = time.time()
+            FleetTrainer(
+                checkpoint_dir=ckpt_dir, checkpoint_every=every, **config
+            ).fit(members)
+            return time.time() - t0
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    every_epoch = timed_ckpt(1)  # worst case: gather+save every epoch
+    # the operator lever (checkpoint_every): one mid-run save
+    amortized = timed_ckpt(max(2, epochs // 2 + 1))
+    return {
+        "checkpoint_overhead_ratio": round(every_epoch / plain, 3),
+        "checkpoint_overhead_ratio_amortized": round(amortized / plain, 3),
+        "checkpoint_fit_seconds": round(every_epoch, 2),
+        "plain_fit_seconds": round(plain, 2),
+    }
+
+
 def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
     """Config 5 — many-model serving through the HBM-resident bank:
     coalesced batched scoring vs one-model-at-a-time (the reference's one
@@ -364,6 +419,7 @@ def main():
         ("bank_serving", bench_bank_serving),
         ("bank_sequence", bench_bank_sequence),
         ("model_zoo", bench_sequence_models),
+        ("checkpoint", bench_checkpoint_overhead),
     ):
         try:
             detail.update(fn())

@@ -1,0 +1,61 @@
+"""North-star path at test scale (BASELINE.json): a fleet of machines is
+gang-built in one vmap program, served from the HBM bank by one process,
+and bulk-scored by the async client — every layer in one flow."""
+
+import aiohttp
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.builder.fleet_build import build_fleet
+from gordo_components_tpu.client import Client
+from gordo_components_tpu.workflow.config import Machine
+
+N_MACHINES = 32
+
+
+@pytest.fixture(scope="module")
+def fleet_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("fleet-models")
+    machines = [
+        Machine(
+            name=f"machine-{i:02d}",
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00Z",
+                "train_end_date": "2020-01-02T00:00:00Z",
+                "tag_list": [f"tag-{i}-a", f"tag-{i}-b", f"tag-{i}-c"],
+            },
+        )
+        for i in range(N_MACHINES)
+    ]
+    results = build_fleet(machines, str(out))
+    assert len(results) == N_MACHINES
+    return str(out)
+
+
+async def test_fleet_build_serve_and_bulk_score(fleet_dir, live_server):
+    async with live_server(fleet_dir) as base_url:
+        # every member banked (homogeneous default fleet pipeline)
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base_url}/gordo/v0/proj/models") as resp:
+                body = await resp.json()
+        assert len(body["models"]) == N_MACHINES
+        assert len(body["bank"]["banked"]) == N_MACHINES
+        assert body["bank"]["fallback"] == {}
+
+        # bulk-score the whole fleet through the real client (each
+        # machine's dataset config round-trips from artifact metadata)
+        client = Client("proj", base_url=base_url, parallelism=8)
+        results = await client.predict_async(
+            pd.Timestamp("2020-01-01T00:00:00Z"),
+            pd.Timestamp("2020-01-01T06:00:00Z"),
+        )
+        assert len(results) == N_MACHINES
+        assert all(r.ok for r in results), [
+            r.error_messages for r in results if not r.ok
+        ]
+        for r in results:
+            assert r.predictions is not None and len(r.predictions) > 0
+            total = r.predictions["total-anomaly-scaled"].values
+            assert np.isfinite(total).all()
