@@ -63,10 +63,31 @@ def bucket_checkpoint_key(payload: Any, data=None) -> str:
 
 
 class FleetBucketCheckpoint:
-    """Save/restore one bucket's mid-training state via orbax."""
+    """Save/restore one bucket's mid-training state via orbax.
 
-    def __init__(self, checkpoint_dir: str, key: str):
+    With ``use_async`` the state write happens in the background
+    (``orbax.AsyncCheckpointer``): ``save`` returns as soon as the state is
+    snapshotted to host memory, the write overlaps the next training
+    epochs, and the COMMIT (``host.json``) for epoch N lands when the save
+    for epoch N+k starts (or at :meth:`flush`/:meth:`clear`). The torn-save
+    guarantee is unchanged — an uncommitted epoch dir is ignored by
+    ``restore`` — but a preemption can lose up to one extra checkpoint
+    interval (the in-flight, uncommitted save). That is the deliberate
+    trade for not serializing orbax writes with the training stream.
+    """
+
+    def __init__(self, checkpoint_dir: str, key: str, use_async: bool = False):
         self.root = os.path.join(os.path.abspath(checkpoint_dir), key)
+        self.use_async = bool(use_async)
+        self._async_ckptr = None
+        self._pending: Optional[tuple] = None  # (epoch, host_state)
+
+    def _checkpointer(self):
+        if self._async_ckptr is None:
+            import orbax.checkpoint as ocp
+
+            self._async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        return self._async_ckptr
 
     # ------------------------------------------------------------------ #
 
@@ -88,24 +109,9 @@ class FleetBucketCheckpoint:
             if os.path.exists(os.path.join(self.root, str(e), "host.json"))
         ]
 
-    def save(self, epoch: int, state_pytree: Any, host_state: Dict[str, Any]) -> None:
-        """Persist after ``epoch`` completed.
-
-        Writes a fresh ``<epoch>`` dir (state first, ``host.json`` commit
-        marker last) and only then prunes older epochs, so the previous
-        good checkpoint survives a preemption mid-save.
-        """
-        import orbax.checkpoint as ocp
-
+    def _commit(self, epoch: int, host_state: Dict[str, Any]) -> None:
+        """Write the commit marker for ``epoch`` and prune older epochs."""
         edir = os.path.join(self.root, str(int(epoch)))
-        if os.path.isdir(edir):  # stale torn save from a previous attempt
-            shutil.rmtree(edir)
-        os.makedirs(edir)
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(
-                os.path.join(edir, "state"),
-                jax.tree.map(np.asarray, state_pytree),
-            )
         host_path = os.path.join(edir, "host.json")
         with open(host_path + ".tmp", "w") as f:
             json.dump({"epoch": int(epoch), **host_state}, f)
@@ -113,7 +119,61 @@ class FleetBucketCheckpoint:
         for old in self._epoch_dirs():
             if old != int(epoch):
                 shutil.rmtree(os.path.join(self.root, str(old)), ignore_errors=True)
-        logger.info("Fleet checkpoint saved at epoch %d -> %s", epoch, edir)
+        logger.info("Fleet checkpoint committed at epoch %d -> %s", epoch, edir)
+
+    def _commit_pending(self) -> None:
+        if self._pending is None:
+            return
+        epoch, host_state = self._pending
+        self._pending = None
+        self._checkpointer().wait_until_finished()
+        self._commit(epoch, host_state)
+
+    def save(self, epoch: int, state_pytree: Any, host_state: Dict[str, Any]) -> None:
+        """Persist after ``epoch`` completed.
+
+        Writes a fresh ``<epoch>`` dir (state first, ``host.json`` commit
+        marker last) and only then prunes older epochs, so the previous
+        good checkpoint survives a preemption mid-save. Async mode defers
+        the commit to the next ``save``/``flush``/``clear`` while the
+        write proceeds in the background.
+        """
+        edir = os.path.join(self.root, str(int(epoch)))
+        if self.use_async:
+            # commit (and prune for) the previous in-flight save FIRST, so
+            # this epoch's fresh dir is never pruned by it
+            self._commit_pending()
+        if os.path.isdir(edir):  # stale torn save from a previous attempt
+            shutil.rmtree(edir)
+        os.makedirs(edir)
+        state_host = jax.tree.map(np.asarray, state_pytree)
+        if self.use_async:
+            import copy
+
+            self._checkpointer().save(os.path.join(edir, "state"), state_host)
+            # deep snapshot: host_state holds LIVE lists (histories) that
+            # keep growing before the deferred commit writes them out
+            self._pending = (int(epoch), copy.deepcopy(host_state))
+            return
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(os.path.join(edir, "state"), state_host)
+        self._commit(int(epoch), host_state)
+
+    def flush(self) -> None:
+        """Wait for and commit any in-flight async save."""
+        self._commit_pending()
+
+    def close(self) -> None:
+        """Release the async writer WITHOUT committing (clear/teardown):
+        waits out any in-flight write so it cannot race a subsequent
+        rmtree/re-save of the same epoch dir."""
+        if self._async_ckptr is not None:
+            self._pending = None
+            self._async_ckptr.wait_until_finished()
+            self._async_ckptr.close()
+            self._async_ckptr = None
 
     def restore(self) -> Optional[Dict[str, Any]]:
         """Returns ``{"epoch": int, "state": pytree, **host_state}`` with
@@ -144,6 +204,10 @@ class FleetBucketCheckpoint:
         would silently destroy the resumable state of a legitimately
         paused/backlogged gang. Use :func:`prune_stale_checkpoints` (or the
         ``checkpoint-prune`` CLI) as an explicit janitor instead."""
+        # an in-flight async writer must not race the rmtree (it could
+        # recreate files after the delete); no commit needed — everything
+        # goes away anyway
+        self.close()
         if os.path.isdir(self.root):
             shutil.rmtree(self.root, ignore_errors=True)
         if prune_stale_after_days is None:

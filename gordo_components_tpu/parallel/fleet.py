@@ -406,9 +406,27 @@ class FleetTrainer:
         bucket_stats = []
         for (n_features, padded_rows), names in sorted(buckets.items()):
             tb = time.time()
-            res, epoch_seconds = self._fit_bucket(
-                n_features, padded_rows, names, arrays
-            )
+            self._active_ckpt = None
+            try:
+                res, epoch_seconds = self._fit_bucket(
+                    n_features, padded_rows, names, arrays
+                )
+            except BaseException:
+                # commit (best-effort) and release the async checkpoint
+                # writer: the pending save is complete training state, so
+                # committing improves the resume point, and closing stops
+                # an orphaned background write from racing a retry
+                ckpt = self._active_ckpt
+                if ckpt is not None:
+                    try:
+                        ckpt.flush()
+                    except Exception:
+                        logger.warning("checkpoint flush failed", exc_info=True)
+                    finally:
+                        ckpt.close()
+                raise
+            finally:
+                self._active_ckpt = None
             out.update(res)
             bucket_stats.append(
                 {
@@ -560,7 +578,13 @@ class FleetTrainer:
                 # but different data must not resume
                 data=(arrays[n] for n in names),
             )
-            ckpt = FleetBucketCheckpoint(self.checkpoint_dir, key)
+            # async: the orbax write overlaps the next epochs; the commit
+            # marker lands at the next save (or the post-loop flush). A
+            # preemption can lose at most one extra checkpoint interval.
+            ckpt = FleetBucketCheckpoint(self.checkpoint_dir, key, use_async=True)
+            # fit() flushes/closes this on any exception so an orphaned
+            # async writer can't race a same-process retry of the bucket
+            self._active_ckpt = ckpt
             resumed = ckpt.restore()
             if resumed is not None:
                 try:
@@ -619,6 +643,12 @@ class FleetTrainer:
                     (str(i), leaf)
                     for i, leaf in enumerate(jax.tree.leaves(best_params))
                 )
+            # start EVERY leaf's device->host copy before the first blocking
+            # np.asarray: the copies overlap instead of paying one full
+            # round-trip per leaf (checkpoint.py then materializes them)
+            for leaf in jax.tree.leaves(tosave):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
             ckpt.save(
                 epoch,
                 tosave,
@@ -756,6 +786,13 @@ class FleetTrainer:
                     )
                     break
             states = carry[0]
+
+        if ckpt is not None:
+            # commit the in-flight async save: a preemption during the
+            # error-scaler pass / unstacking below can then resume from
+            # the last epoch checkpoint (the write already overlapped the
+            # epochs, so this wait is near-free)
+            ckpt.flush()
 
         final_params = best_params if best_params is not None else states.params
 

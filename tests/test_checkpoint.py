@@ -189,3 +189,63 @@ def test_prune_stale_checkpoints_janitor(tmp_path):
     assert not os.path.isdir(stale)
     assert os.path.isdir(fresh)
     assert os.path.isdir(not_ours)  # non-checkpoint dirs never touched
+
+
+class TestAsyncProtocol:
+    """Direct tests of the deferred-commit async checkpoint protocol —
+    the path FleetTrainer actually runs (use_async=True)."""
+
+    def _state(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return {"state": {"0": rng.rand(4, 8).astype("float32")}}
+
+    def test_commit_is_deferred_to_next_save(self, tmp_path):
+        ck = FleetBucketCheckpoint(str(tmp_path), "a" * 24, use_async=True)
+        ck.save(0, self._state(0), {"histories": [[0.5]]})
+        # no commit marker yet: an immediate crash leaves a torn epoch 0
+        assert ck.restore() is None
+        ck.save(1, self._state(1), {"histories": [[0.5, 0.4]]})
+        # the NEXT save committed epoch 0
+        resumed = ck.restore()
+        assert resumed is not None and resumed["epoch"] == 0
+        ck.flush()
+        resumed = ck.restore()
+        assert resumed["epoch"] == 1
+        assert resumed["histories"] == [[0.5, 0.4]]
+        ck.close()
+
+    def test_deferred_host_state_is_snapshotted(self, tmp_path):
+        """Live lists mutated after save() must not leak into the
+        deferred commit."""
+        ck = FleetBucketCheckpoint(str(tmp_path), "b" * 24, use_async=True)
+        histories = [[0.5]]
+        ck.save(0, self._state(), {"histories": histories})
+        histories[0].append(0.4)  # training continues past the save
+        ck.flush()
+        assert ck.restore()["histories"] == [[0.5]]
+        ck.close()
+
+    def test_commit_prunes_older_epochs_only_after_wait(self, tmp_path):
+        ck = FleetBucketCheckpoint(str(tmp_path), "c" * 24, use_async=True)
+        for e in range(3):
+            ck.save(e, self._state(e), {"histories": []})
+        ck.flush()
+        # only the newest committed epoch dir remains
+        assert ck._committed_epochs() == [2]
+        ck.close()
+
+    def test_torn_async_save_ignored_and_previous_survives(self, tmp_path):
+        ck = FleetBucketCheckpoint(str(tmp_path), "d" * 24, use_async=True)
+        ck.save(0, self._state(0), {"histories": []})
+        ck.flush()  # epoch 0 committed
+        ck.save(1, self._state(1), {"histories": []})
+        ck.close()  # waits but does NOT commit -> epoch 1 stays torn
+        resumed = FleetBucketCheckpoint(str(tmp_path), "d" * 24).restore()
+        assert resumed is not None and resumed["epoch"] == 0
+
+    def test_clear_discards_pending(self, tmp_path):
+        ck = FleetBucketCheckpoint(str(tmp_path), "e" * 24, use_async=True)
+        ck.save(0, self._state(), {"histories": []})
+        ck.clear()
+        assert not os.path.isdir(ck.root)
+        assert ck.restore() is None
