@@ -219,3 +219,64 @@ class TestBuildFleet:
         )
         results = build_fleet(machines, str(tmp_path / "out"))
         assert set(results) == {"machine-0", "machine-1", "bespoke"}
+
+
+def test_distributed_gang_uses_local_device_mesh(tmp_path, monkeypatch):
+    """ADVICE r1 (high): with members partitioned per host, the trainer
+    mesh must span only THIS host's devices — a global mesh would place
+    host-local data onto non-addressable shardings on a real pod. On a
+    single host local == global, so fake a 4-device "host" subset: a
+    regression back to the global mesh then fails the assertion."""
+    import jax
+
+    import gordo_components_tpu.builder.fleet_build as fb
+    from gordo_components_tpu.parallel.fleet import FleetTrainer
+    from gordo_components_tpu.workflow.config import Machine
+
+    monkeypatch.setattr(
+        "gordo_components_tpu.parallel.distributed.initialize_distributed",
+        lambda *a, **k: True,
+    )
+    host_devices = jax.devices()[:4]
+    monkeypatch.setattr(jax, "local_devices", lambda *a, **k: host_devices)
+    captured = {}
+    orig_init = FleetTrainer.__init__
+
+    def spy_init(self, *a, **k):
+        captured["mesh"] = k.get("mesh")
+        return orig_init(self, *a, **k)
+
+    monkeypatch.setattr(FleetTrainer, "__init__", spy_init)
+
+    machines = [
+        Machine(
+            name="m-0",
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00Z",
+                "train_end_date": "2020-01-01T06:00:00Z",
+                "tag_list": ["a", "b"],
+            },
+            model={
+                "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        "sklearn.pipeline.Pipeline": {
+                            "steps": [
+                                "sklearn.preprocessing.MinMaxScaler",
+                                {
+                                    "gordo_components_tpu.models.AutoEncoder": {
+                                        "epochs": 1,
+                                        "batch_size": 64,
+                                    }
+                                },
+                            ]
+                        }
+                    }
+                }
+            },
+        )
+    ]
+    fb.build_fleet(machines, str(tmp_path / "out"), distributed=True)
+    mesh = captured["mesh"]
+    assert mesh is not None
+    assert list(mesh.devices.flat) == host_devices  # NOT all 8 devices

@@ -134,3 +134,38 @@ class TestGenerator:
         manifest = generate_workflow(config, "p", namespace="custom-ns")
         docs = [d for d in yaml.safe_load_all(manifest) if d]
         assert all(d["metadata"]["namespace"] == "custom-ns" for d in docs if d["kind"] == "Job")
+
+
+def test_gang_jobs_wire_resume_and_heartbeat_env():
+    """Retried gang Jobs must resume from checkpoints and publish
+    heartbeats: CHECKPOINT_DIR / GANG_STATE_DIR / GANG_ID are wired into
+    every builder container on the shared artifact volume."""
+    config = NormalizedConfig(CONFIG_YAML)
+    docs = [d for d in yaml.safe_load_all(generate_workflow(config, "p")) if d]
+    jobs = [d for d in docs if d["kind"] == "Job"]
+    assert jobs
+    gang_ids = set()
+    for job in jobs:
+        container = job["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["CHECKPOINT_DIR"].endswith("/.checkpoints/p")
+        assert env["GANG_STATE_DIR"].endswith("/.gang-state/p")
+        gang_ids.add(env["GANG_ID"])
+        # checkpoint/state dirs live on the mounted artifact volume
+        mounts = {m["name"] for m in container["volumeMounts"]}
+        assert "artifacts" in mounts
+    assert len(gang_ids) == len(jobs)  # unique heartbeat identity per gang
+
+
+def test_watchman_deployment_reads_gang_state():
+    config = NormalizedConfig(CONFIG_YAML)
+    docs = [d for d in yaml.safe_load_all(generate_workflow(config, "p")) if d]
+    watchman = next(
+        d for d in docs
+        if d["kind"] == "Deployment" and "watchman" in d["metadata"]["name"]
+    )
+    container = watchman["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["GANG_STATE_DIR"].endswith("/.gang-state/p")
+    mounts = {m["name"] for m in container["volumeMounts"]}
+    assert "artifacts" in mounts
