@@ -25,16 +25,25 @@ EXIT_BUILD_ERROR = 83
 
 @click.group("gordo-components-tpu")
 @click.option("--log-level", default="INFO", envvar="LOG_LEVEL")
+@click.option("--platform", default=None, envvar="JAX_PLATFORMS",
+              help="Pin the JAX backend (e.g. 'cpu', 'tpu'). Applied "
+                   "in-process BEFORE any device use: an env var alone "
+                   "cannot override a site-installed platform pin, and a "
+                   "wedged accelerator plugin hangs rather than errors")
 @click.option("--profile-dir", default=None, envvar="GORDO_PROFILE_DIR",
               help="Write jax.profiler traces of train/build hot sections "
                    "here (TensorBoard/Perfetto-viewable)")
-def gordo(log_level, profile_dir):
+def gordo(log_level, platform, profile_dir):
     """TPU-native gordo: build, serve, and orchestrate fleets of
     time-series anomaly-detection models."""
     logging.basicConfig(
         level=getattr(logging, log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     if profile_dir:
         os.environ["GORDO_PROFILE_DIR"] = profile_dir
 

@@ -805,10 +805,14 @@ class FleetTrainer:
         feat_thresh = np.asarray(feat_thresh)
         total_thresh = np.asarray(total_thresh)
 
-        # ---- unstack to host ----
-        params_np = jax.tree.map(np.asarray, final_params)
-        scalers_np = jax.tree.map(np.asarray, scalers)
-        err_np = jax.tree.map(np.asarray, err_scalers)
+        # ---- unstack to host (pipeline every leaf's device->host copy
+        # before the first blocking materialization — per-leaf fetches pay
+        # a full round-trip each otherwise) ----
+        device_trees = (final_params, scalers, err_scalers)
+        for leaf in jax.tree.leaves(device_trees):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        params_np, scalers_np, err_np = jax.tree.map(np.asarray, device_trees)
 
         out = {}
         for i, name in enumerate(names):  # drop dummy pads (i >= M_real)
