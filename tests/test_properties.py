@@ -1,0 +1,127 @@
+"""Property-based tests (hypothesis) for the invariants the fleet engine
+leans on: the quantization ladder, scaler round-trips, masked-loss
+normalization, and definition round-trips over generated configs."""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from gordo_components_tpu.parallel.fleet import quantize_batch_count
+
+
+class TestQuantizationLadder:
+    @given(st.integers(min_value=1, max_value=10**6))
+    def test_monotone_idempotent_bounded(self, n):
+        q = quantize_batch_count(n)
+        # covers n, idempotent, waste bounded by 50% (ladder step is 1.5x)
+        assert q >= n
+        assert quantize_batch_count(q) == q
+        assert q <= max(2, (n * 3 + 1) // 2)
+
+    @given(st.integers(min_value=1, max_value=10**5), st.integers(min_value=1, max_value=10**5))
+    def test_monotonic(self, a, b):
+        if a <= b:
+            assert quantize_batch_count(a) <= quantize_batch_count(b)
+
+    @given(st.integers(min_value=1, max_value=10**4))
+    def test_ladder_membership(self, n):
+        """Every output is a power of two or 1.5x a power of two."""
+        q = quantize_batch_count(n)
+        while q % 2 == 0:
+            q //= 2
+        assert q in (1, 3)
+
+
+class TestScalerRoundTrip:
+    @settings(max_examples=25, deadline=None)
+    @given(
+        st.integers(min_value=2, max_value=40),
+        st.integers(min_value=1, max_value=6),
+        st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def test_minmax_inverse_identity(self, rows, feats, seed):
+        import jax.numpy as jnp
+
+        from gordo_components_tpu.ops.scaler import (
+            fit_minmax,
+            scaler_inverse_transform,
+            scaler_transform,
+        )
+
+        rng = np.random.RandomState(seed)
+        X = jnp.asarray((rng.randn(rows, feats) * 10).astype("float32"))
+        params = fit_minmax(X)
+        back = scaler_inverse_transform(params, scaler_transform(params, X))
+        np.testing.assert_allclose(np.asarray(back), np.asarray(X), rtol=1e-4, atol=1e-3)
+        # transformed training data spans [0, 1] per feature (constant
+        # features map to a constant inside the range)
+        T = np.asarray(scaler_transform(params, X))
+        assert T.min() >= -1e-5 and T.max() <= 1 + 1e-5
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.integers(min_value=0, max_value=2**31 - 1))
+    def test_constant_features_do_not_blow_up(self, seed):
+        import jax.numpy as jnp
+
+        from gordo_components_tpu.ops.scaler import fit_minmax, scaler_transform
+
+        rng = np.random.RandomState(seed)
+        X = np.ones((16, 3), dtype="float32") * rng.randn(3).astype("float32")
+        T = np.asarray(scaler_transform(fit_minmax(jnp.asarray(X)), jnp.asarray(X)))
+        assert np.isfinite(T).all()
+
+
+class TestMaskedLoss:
+    @settings(max_examples=25, deadline=None)
+    @given(
+        st.integers(min_value=1, max_value=30),
+        st.integers(min_value=0, max_value=30),
+        st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def test_padding_rows_never_change_the_loss(self, real, pad, seed):
+        """mse over [X; padding] with a mask == mse over X alone."""
+        import jax.numpy as jnp
+
+        from gordo_components_tpu.ops.losses import mse_loss
+
+        rng = np.random.RandomState(seed)
+        pred = rng.randn(real, 4).astype("float32")
+        target = rng.randn(real, 4).astype("float32")
+        base = float(
+            mse_loss(jnp.asarray(pred), jnp.asarray(target), jnp.ones((real,)))
+        )
+        pred_p = np.concatenate([pred, 7.0 * np.ones((pad, 4), "float32")])
+        targ_p = np.concatenate([target, -3.0 * np.ones((pad, 4), "float32")])
+        mask = np.concatenate([np.ones((real,), "float32"), np.zeros((pad,), "float32")])
+        padded = float(
+            mse_loss(jnp.asarray(pred_p), jnp.asarray(targ_p), jnp.asarray(mask))
+        )
+        np.testing.assert_allclose(padded, base, rtol=1e-5)
+
+
+class TestDefinitionRoundTrip:
+    @settings(max_examples=20, deadline=None)
+    @given(
+        st.sampled_from(
+            ["feedforward_hourglass", "feedforward_symmetric", "feedforward_model"]
+        ),
+        st.integers(min_value=1, max_value=200),
+        st.integers(min_value=1, max_value=512),
+        st.floats(min_value=1e-5, max_value=0.5, allow_nan=False),
+    )
+    def test_estimator_definitions_idempotent(self, kind, epochs, batch_size, lr):
+        from gordo_components_tpu.models import AutoEncoder
+        from gordo_components_tpu.serializer import (
+            pipeline_from_definition,
+            pipeline_into_definition,
+        )
+
+        est = AutoEncoder(
+            kind=kind, epochs=epochs, batch_size=batch_size, learning_rate=lr
+        )
+        d1 = pipeline_into_definition(est)
+        clone = pipeline_from_definition(d1)
+        d2 = pipeline_into_definition(clone)
+        assert d1 == d2
+        assert clone.get_params()["epochs"] == epochs
+        assert clone.get_params()["learning_rate"] == lr
