@@ -65,11 +65,11 @@ print("OWNED", jax.process_index(), ",".join(mine), flush=True)
 """
 
 
-def test_real_two_process_rendezvous(tmp_path):
-    """Two actual processes rendezvous through jax.distributed over
-    loopback DCN and compute disjoint member slices — the real
-    multi-controller path, which the reference (K8s YAML-only tests,
-    SURVEY.md §4) never exercised."""
+def _run_workers(script: str, argv=(), n_processes: int = 2, timeout: float = 120.0):
+    """Launch ``n_processes`` real worker processes that rendezvous over
+    loopback jax.distributed; returns their stdouts. Kills every worker on
+    any failure — an orphaned peer would otherwise sit in distributed
+    barriers until JAX's internal timeouts fire."""
     import socket
     import subprocess
     import sys
@@ -79,30 +79,44 @@ def test_real_two_process_rendezvous(tmp_path):
         port = s.getsockname()[1]
 
     procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            GORDO_COORDINATOR=f"127.0.0.1:{port}",
-            GORDO_NUM_PROCESSES="2",
-            GORDO_PROCESS_ID=str(pid),
-            JAX_PLATFORMS="cpu",
-        )
-        env.pop("XLA_FLAGS", None)  # no virtual device fan-out in workers
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", _WORKER],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    try:
+        for pid in range(n_processes):
+            env = dict(
+                os.environ,
+                GORDO_COORDINATOR=f"127.0.0.1:{port}",
+                GORDO_NUM_PROCESSES=str(n_processes),
+                GORDO_PROCESS_ID=str(pid),
+                JAX_PLATFORMS="cpu",
             )
-        )
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=120)
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(out)
+            env.pop("XLA_FLAGS", None)  # no virtual device fan-out in workers
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", script, *argv],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                )
+            )
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(out)
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_real_two_process_rendezvous(tmp_path):
+    """Two actual processes rendezvous through jax.distributed over
+    loopback DCN and compute disjoint member slices — the real
+    multi-controller path, which the reference (K8s YAML-only tests,
+    SURVEY.md §4) never exercised."""
+    outs = _run_workers(_WORKER)
     owned = {}
     for out in outs:
         for line in out.splitlines():
@@ -159,3 +173,78 @@ def _slice(n, pid, count):
     base, extra = divmod(n, count)
     start = pid * base + min(pid, extra)
     return start, start + base + (1 if pid < extra else 0)
+
+
+_BUILD_WORKER = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gordo_components_tpu.builder.fleet_build import build_fleet
+from gordo_components_tpu.workflow.config import Machine
+
+out_dir, state_dir = sys.argv[1], sys.argv[2]
+machines = [
+    Machine(
+        name=f"m-{i}",
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2020-01-01T00:00:00Z",
+            "train_end_date": "2020-01-01T06:00:00Z",
+            "tag_list": ["a", "b"],
+        },
+        model={
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {"gordo_components_tpu.models.AutoEncoder": {
+                                "epochs": 1, "batch_size": 32}},
+                        ]
+                    }
+                }
+            }
+        },
+    )
+    for i in range(4)
+]
+results = build_fleet(
+    machines, out_dir, distributed=True,
+    state_dir=state_dir, gang_id="gang-x",
+)
+print("BUILT", jax.process_index(), ",".join(sorted(results)), flush=True)
+"""
+
+
+def test_real_two_process_distributed_build(tmp_path):
+    """The flagship pod-scale scenario end-to-end with two REAL processes:
+    rendezvous over loopback, disjoint member slices, each host training
+    its slice on its LOCAL device mesh, artifacts landing in one shared
+    output dir, per-host heartbeats that don't clobber each other."""
+    out_dir = str(tmp_path / "models")
+    state_dir = str(tmp_path / "state")
+    outs = _run_workers(_BUILD_WORKER, argv=(out_dir, state_dir), timeout=240)
+    built = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("BUILT"):
+                _, pid, names = line.split(" ", 2)
+                built[int(pid)] = names.split(",")
+
+    # disjoint slices covering the fleet
+    assert set(built) == {0, 1}
+    assert not set(built[0]) & set(built[1])
+    assert sorted(built[0] + built[1]) == [f"m-{i}" for i in range(4)]
+    # every artifact serves from the shared volume
+    from gordo_components_tpu import serializer
+
+    for i in range(4):
+        md = serializer.load_metadata(os.path.join(out_dir, f"m-{i}"))
+        assert md["model"]["fleet_trained"]
+    # per-host heartbeats: the pinned gang id was suffixed per process
+    from gordo_components_tpu.workflow.gang_state import read_gang_states
+
+    states = read_gang_states(state_dir)
+    ids = sorted(s["gang_id"] for s in states)
+    assert ids == ["gang-x-host0", "gang-x-host1"]
+    assert all(s["phase"] == "done" and s["built"] == 2 for s in states)
