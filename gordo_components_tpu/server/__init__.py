@@ -55,6 +55,15 @@ def build_app(
                 )
                 engine.start()
                 app["bank_engine"] = engine
+                # pre-compile scoring programs off the request path so the
+                # first request doesn't pay the XLA compile — in the
+                # BACKGROUND: awaiting here would hold the port closed for
+                # the whole compile loop and fail readiness probes on
+                # large fleets
+                if os.environ.get("GORDO_SERVER_WARMUP", "1") != "0":
+                    app["warmup_future"] = asyncio.get_running_loop().run_in_executor(
+                        None, bank.warmup
+                    )
 
             app.on_startup.append(_start_engine)
 
@@ -62,6 +71,14 @@ def build_app(
         engine = app.get("bank_engine")
         if engine is not None:
             await engine.stop()
+        fut = app.get("warmup_future")
+        if fut is not None and not fut.done():
+            # executor jobs can't be interrupted; just don't tear the app
+            # down from under a still-running compile
+            import contextlib
+
+            with contextlib.suppress(Exception):
+                await fut
 
     app.on_cleanup.append(_stop_engine)
     app.add_routes(routes)

@@ -105,6 +105,14 @@ async def reload_models(request: web.Request) -> web.Response:
             bank = await loop.run_in_executor(
                 None, ModelBank.from_models, collection.models
             )
+            # the rebuilt bank's jit closures are cold: re-warm them here,
+            # inside the reload (still behind the single-flight lock, off
+            # the scoring path) so the first request after a reload doesn't
+            # pay the XLA compile either
+            import os
+
+            if len(bank) and os.environ.get("GORDO_SERVER_WARMUP", "1") != "0":
+                await loop.run_in_executor(None, bank.warmup)
             app["bank"] = bank
             engine = app.get("bank_engine")
             if engine is not None:

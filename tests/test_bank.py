@@ -294,3 +294,38 @@ class TestSequenceBank:
         assert cov["banked"] == len(models)
         assert "pca" in cov["fallback"]
         assert "non-affine" in cov["fallback"]["pca"]
+
+
+def test_bank_warmup_precompiles_buckets(fleet_models):
+    """warmup() compiles each bucket's scoring program so the first real
+    request is served from the jit cache, and never raises."""
+    models, data = fleet_models
+    bank = ModelBank.from_models(models)
+    assert bank.warmup(rows=64) == bank.n_buckets
+    sizes_after_warmup = {
+        k: b._score._cache_size() for k, b in bank._buckets.items()
+    }
+    assert all(n == 1 for n in sizes_after_warmup.values())
+    # a request at the warmed row shape REUSES the compiled program (the
+    # warmup shape must keep matching score_many's shape computation)
+    X = data["plain"][:64]
+    pd.testing.assert_frame_equal(
+        bank.score("plain", X).to_frame(),
+        models["plain"].anomaly(X),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+    key = bank._index["plain"][0]
+    assert bank._buckets[key]._score._cache_size() == 1  # no new compile
+
+
+def test_bank_warmup_covers_sequence_buckets():
+    """Sequence buckets warm with a T that covers their lookback even if
+    the requested warmup rows are smaller."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(120, 3).astype("float32")
+    det = _make_det(
+        X, base=LSTMAutoEncoder(lookback_window=48, epochs=1, batch_size=64)
+    )
+    bank = ModelBank.from_models({"long-lb": det})
+    assert bank.warmup(rows=8) == 1  # 8 < lookback: clamped internally
