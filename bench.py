@@ -33,6 +33,7 @@ vmap/shard_map training over pod-style sequential builds.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -50,16 +51,26 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def probe_backend(timeout: float = 180.0, attempts: int = 3):
+def probe_backend(timeout: float = 180.0, attempts: int = 2):
     """Probe the default JAX backend in a subprocess.
 
-    A wedged accelerator plugin can HANG during backend init (observed:
-    sleep/retry loop inside the plugin) — no in-process try/except can
-    recover from that, so the probe runs out-of-process with a hard
-    timeout. Returns (platform, device_kind, n_devices) or (None, None, 0).
+    A wedged accelerator plugin can HANG rather than error — observed in two
+    distinct layers across rounds: (a) backend INIT blocks in a sleep/retry
+    loop, and (b) init succeeds (devices list fine) but the first
+    device-transfer/execution blocks forever in a socket recv. No in-process
+    try/except can recover from either, so the probe runs out-of-process with
+    a hard timeout AND must exercise the full execute+fetch path, not just
+    `jax.devices()`. Returns (platform, device_kind, n_devices) or
+    (None, None, 0).
     """
     code = (
-        "import jax; d = jax.devices(); "
+        "import jax, jax.numpy as jnp; d = jax.devices(); "
+        # full data path: host->device transfer, XLA compile, MXU execute,
+        # device->host fetch. A tunnel that only answers control-plane RPCs
+        # (device listing) but wedges on the data plane must fail this.
+        "x = jnp.ones((128, 128), jnp.float32); "
+        "s = float(jax.jit(lambda a: (a @ a).sum())(x)); "
+        "assert s == 128.0 * 128 * 128, s; "
         "print(d[0].platform); print(d[0].device_kind); print(len(d))"
     )
     for attempt in range(attempts):
@@ -394,42 +405,290 @@ def bench_server_scoring(n_features=10, batch=4096, iters=20):
     return {"server_recon_samples_per_sec": round(batch * iters / elapsed, 1)}
 
 
+def bench_host_pipeline(n_members=32, n_tags=10, days=30):
+    """Host-side staging throughput: members/sec through the full
+    provider->resample->join->dropna dataset path (SURVEY.md §7 hard part
+    2 — one process feeds the whole gang, so staging rate bounds fleet
+    build throughput together with the device step)."""
+    from gordo_components_tpu.dataset.datasets import TimeSeriesDataset
+    from gordo_components_tpu.dataset.data_provider.providers import (
+        RandomDataProvider,
+    )
+
+    def stage(i):
+        ds = TimeSeriesDataset(
+            train_start_date="2020-01-01",
+            train_end_date=f"2020-01-{days + 1:02d}",
+            tag_list=[f"bench-{i}-{j}" for j in range(n_tags)],
+            data_provider=RandomDataProvider(),
+        )
+        X, _ = ds.get_data()
+        return len(X)
+
+    stage(0)  # warm imports
+    t0 = time.time()
+    rows = sum(stage(i) for i in range(n_members))
+    seq_el = time.time() - t0
+
+    import concurrent.futures
+
+    # the same sizing rule fleet_build's member-loading pool uses, so the
+    # threaded figure predicts what a fleet build actually achieves
+    from gordo_components_tpu.utils.staging import load_worker_count
+
+    workers = load_worker_count(n_members)
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+        sum(pool.map(stage, range(n_members)))
+    par_el = time.time() - t0
+    return {
+        "host_staging_members_per_sec": round(n_members / seq_el, 2),
+        "host_staging_members_per_sec_threaded": round(n_members / par_el, 2),
+        "host_staging_rows_per_member": rows // n_members,
+        "host_staging_threads": workers,
+    }
+
+
+METRICS = (
+    ("fleet", bench_fleet),
+    ("sequential", bench_single_sequential),
+    ("server_scoring", bench_server_scoring),
+    ("bank_serving", bench_bank_serving),
+    ("bank_sequence", bench_bank_sequence),
+    ("model_zoo", bench_sequence_models),
+    ("checkpoint", bench_checkpoint_overhead),
+    ("host_pipeline", bench_host_pipeline),
+)
+
+# The CPU fallback exists to keep the JSON line complete when the TPU is
+# unreachable — its numbers are diagnostic, not the record. Full-size
+# configs take ~16 min on one CPU core (measured), which risks the
+# driver's whole-run timeout, so the expensive metrics shrink; each
+# metric's own config/size fields record what actually ran.
+CPU_KWARGS = {
+    "fleet": dict(n_models=256, epochs=3),
+    "sequential": dict(epochs=3, n_probe=2),
+    "model_zoo": dict(rows=720, epochs=2),
+    "checkpoint": dict(n_models=64, epochs=3),
+}
+
+# A metric that produces no result for this long is declared wedged: the
+# remote data plane can block in a socket recv with no error, so wall-clock
+# stall is the only available signal. Generous enough for tunneled-TPU
+# first-compiles; small enough that the driver's own timeout isn't burned
+# on a single dead metric.
+STALL_SECONDS = float(os.environ.get("GRAFT_BENCH_STALL_S", 600))
+
+
+def run_metrics_child(skip: set, platform: str | None) -> None:
+    """Child mode: run each metric, print one ``METRIC <name> <json>`` line
+    as it completes (stdout, flushed) so the parent keeps partial results
+    even if a later metric wedges the process.
+
+    The platform pin MUST happen in-process via ``jax.config`` — observed on
+    this machine: setting ``JAX_PLATFORMS=cpu`` in the environment hangs
+    under the accelerator site hook, while the config update works.
+    """
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    for name, fn in METRICS:
+        if name in skip:
+            continue
+        # announce the start: the parent treats any line as progress, so the
+        # stall deadline applies per metric, not across a silent sequence
+        print(f"METRIC_START {name}", flush=True)
+        t0 = time.time()
+        kwargs = CPU_KWARGS.get(name, {}) if platform == "cpu" else {}
+        try:
+            out = fn(**kwargs)
+        except Exception as exc:
+            print(
+                "METRIC_ERROR "
+                + json.dumps({"name": name, "error": f"{type(exc).__name__}: {exc}"}),
+                flush=True,
+            )
+        else:
+            out[f"{name}_bench_seconds"] = round(time.time() - t0, 1)
+            if kwargs:
+                # mark shrunk CPU configs so their numbers are never
+                # mistaken for full-size runs
+                out[f"{name}_scaled_config"] = kwargs
+            print(f"METRIC {name} " + json.dumps(out), flush=True)
+
+
+def run_metrics_supervised(env_platform, detail, errors, skip):
+    """Run the metric suite in a supervised child.
+
+    The parent enforces a stall watchdog: if the child produces no new
+    metric line for STALL_SECONDS it is killed (a blocked recv never
+    raises, so this is the only recovery). Returns the set of metric names
+    that completed."""
+    args = [sys.executable, os.path.abspath(__file__), "--child"]
+    if env_platform:
+        # passed as an argv flag and applied in-process by the child:
+        # JAX_PLATFORMS in the env hangs under the accelerator site hook
+        args += ["--platform", env_platform]
+    if skip:
+        args += ["--skip", ",".join(sorted(skip))]
+    proc = subprocess.Popen(
+        args,
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    done = set(skip)
+    import threading
+
+    lines: list = []
+    got_line = threading.Event()
+    eof = threading.Event()
+
+    def reader():
+        try:
+            for line in proc.stdout:
+                lines.append(line)
+                got_line.set()
+        finally:
+            # EOF (or reader crash): set the sticky flag FIRST, then wake
+            # the supervisor — the wake-up can race with the supervisor's
+            # clear(), but the sticky flag is checked explicitly so a clean
+            # exit is never mistaken for a stall and waited on forever
+            eof.set()
+            got_line.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    consumed = 0
+    started = None
+    stalled = False
+    while True:
+        got_line.clear()
+        # snapshot before advancing: the reader can append between the
+        # slice and the counter update, and that line must not be skipped
+        snapshot = lines[consumed:]
+        consumed += len(snapshot)
+        progressed = bool(snapshot)
+        for line in snapshot:
+            line = line.strip()
+            try:
+                if line.startswith("METRIC "):
+                    _, name, payload = line.split(" ", 2)
+                    detail.update(json.loads(payload))
+                    done.add(name)
+                elif line.startswith("METRIC_ERROR "):
+                    rec = json.loads(line.split(" ", 1)[1])
+                    errors[rec["name"]] = rec["error"]
+                    done.add(rec["name"])
+                elif line.startswith("METRIC_START "):
+                    started = line.split(" ", 1)[1]
+            except (ValueError, KeyError) as exc:
+                # a child killed mid-write leaves a truncated line; keep
+                # every result already collected instead of crashing out
+                errors["malformed_line"] = f"{type(exc).__name__}: {line[:120]}"
+        if not progressed:
+            # exit only once the READER is done (eof), never on poll()
+            # alone: the child can be reaped while its final lines still
+            # sit in the pipe buffer, and those must not be dropped
+            if eof.is_set():
+                proc.wait()
+                break
+            # wait for the next line with the stall deadline
+            if not got_line.wait(timeout=STALL_SECONDS):
+                stalled = True
+                running = [n for n, _ in METRICS if n not in done]
+                wedged = started if started not in done and started else (
+                    running[0] if running else "?"
+                )
+                if proc.poll() is None:
+                    errors[f"stall:{wedged}"] = (
+                        f"no progress for {STALL_SECONDS:.0f}s on "
+                        f"platform={env_platform or 'default'}; child killed"
+                    )
+                    proc.kill()
+                    proc.wait()
+                else:
+                    # child already dead but the pipe never closed (an
+                    # inherited fd in a grandchild can hold it open): do
+                    # not spin on the watchdog forever
+                    errors[f"stall:{wedged}"] = (
+                        f"child exited rc={proc.returncode} but its stdout "
+                        "pipe stayed open; presumed crashed"
+                    )
+                break
+    rc = proc.returncode
+    if rc not in (0, None) and not stalled:
+        # abnormal exit (segfault/OOM-kill) that the stall path didn't
+        # already attribute: record it instead of silently losing metrics
+        errors["child_exit"] = (
+            f"benchmark child exited rc={rc} on "
+            f"platform={env_platform or 'default'}"
+        )
+    return done
+
+
 def main():
+    if "--child" in sys.argv:
+        skip = set()
+        if "--skip" in sys.argv:
+            skip = set(sys.argv[sys.argv.index("--skip") + 1].split(","))
+        platform = None
+        if "--platform" in sys.argv:
+            platform = sys.argv[sys.argv.index("--platform") + 1]
+        run_metrics_child(skip, platform)
+        return 0
+
     detail = {}
     errors = {}
 
     platform, device_kind, n_devices = probe_backend()
+    env_platform = None
+    if platform == "cpu":
+        # CPU-only machine: pass the platform down so the child applies
+        # the CPU-sized configs instead of full-size ones under the
+        # stall watchdog (full-size fleet alone exceeds the deadline on
+        # one core)
+        env_platform = "cpu"
     if platform is None:
         # default backend unusable (hang or error): fall back to CPU so the
         # run still yields numbers, with the platform recorded honestly
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         errors["backend"] = "default backend probe failed; CPU fallback"
+        env_platform = "cpu"
         platform, device_kind, n_devices = "cpu", "cpu", 1
 
     detail["platform"] = platform
     detail["device_kind"] = device_kind
     detail["n_devices"] = n_devices
 
-    for name, fn in (
-        ("fleet", bench_fleet),
-        ("sequential", bench_single_sequential),
-        ("server_scoring", bench_server_scoring),
-        ("bank_serving", bench_bank_serving),
-        ("bank_sequence", bench_bank_sequence),
-        ("model_zoo", bench_sequence_models),
-        ("checkpoint", bench_checkpoint_overhead),
-    ):
-        try:
-            detail.update(fn())
-        except Exception as exc:  # isolate: one dead metric, not a dead run
-            errors[name] = f"{type(exc).__name__}: {exc}"
+    done = run_metrics_supervised(env_platform, detail, errors, set())
+    missing = {n for n, _ in METRICS} - done
+    fell_back: set = set()
+    if missing and env_platform != "cpu":
+        # the accelerator data plane wedged mid-run (probe passed, a metric
+        # stalled): finish the remaining metrics on CPU so the line still
+        # carries every number, honestly labelled
+        errors["fallback"] = (
+            f"metrics {sorted(missing)} re-run on CPU after accelerator stall"
+        )
+        detail["fallback_platform"] = "cpu"
+        detail["fallback_metrics"] = sorted(missing)
+        fell_back = set(missing)
+        done = run_metrics_supervised("cpu", detail, errors, done)
+    final_missing = {n for n, _ in METRICS} - done
+    if final_missing:
+        errors["missing_metrics"] = ", ".join(sorted(final_missing))
 
     fleet_rate = detail.get("fleet_models_per_hour_per_chip")
     seq_rate = detail.get("sequential_models_per_hour_per_chip")
+    # a speedup ratio is only meaningful when both rates came off the same
+    # platform — after a partial CPU fallback the mixed ratio would be
+    # inflated by orders of magnitude
+    same_platform = ("fleet" in fell_back) == ("sequential" in fell_back)
     peak = PEAK_BF16_FLOPS.get(device_kind or "")
-    if peak and detail.get("achieved_flops_per_sec"):
+    # MFU only makes sense when the FLOP rate came off the probed chip —
+    # after a fleet CPU-fallback the division against TPU peak is bogus
+    if peak and detail.get("achieved_flops_per_sec") and "fleet" not in fell_back:
         detail["mfu"] = round(detail["achieved_flops_per_sec"] / peak, 6)
         detail["peak_bf16_flops_per_sec"] = peak
 
@@ -438,7 +697,9 @@ def main():
         "value": fleet_rate,
         "unit": "models/hour/chip",
         "vs_baseline": (
-            round(fleet_rate / seq_rate, 2) if fleet_rate and seq_rate else None
+            round(fleet_rate / seq_rate, 2)
+            if fleet_rate and seq_rate and same_platform
+            else None
         ),
         "detail": detail,
     }

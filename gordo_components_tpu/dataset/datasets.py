@@ -40,10 +40,25 @@ def join_timeseries(
     resampling_end: pd.Timestamp,
     resolution: str,
     aggregation: str = "mean",
+    fast: bool = True,
 ) -> Tuple[pd.DataFrame, Dict[str, Any]]:
     """Resample each tag series to ``resolution`` then outer-join on the
-    timestamp index; returns the joined frame + per-tag row metadata."""
+    timestamp index; returns the joined frame + per-tag row metadata.
+
+    For the default ``mean`` aggregation a fused numpy path (one bincount
+    pass per tag, no intermediate frames) replaces the per-tag pandas
+    resample loop — the host staging hot loop at fleet scale (SURVEY.md §7
+    hard part 2). ``fast=False`` forces the pandas path (used by the
+    parity tests)."""
     resolution = _normalize_resolution(resolution)
+    if fast and aggregation == "mean":
+        from gordo_components_tpu.dataset.resample import fused_mean_join
+
+        fused = fused_mean_join(
+            series_list, resampling_start, resampling_end, resolution
+        )
+        if fused is not None:
+            return fused
     resampled = []
     meta: Dict[str, Any] = {}
     for series in series_list:

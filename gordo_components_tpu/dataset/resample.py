@@ -1,0 +1,205 @@
+"""Fused multi-tag resample+join fast path.
+
+The reference joins tag series by resampling each with pandas and outer-
+joining the results (SURVEY.md §3.1 — the per-tag IO/join hot loop inside
+one builder pod). Per-call pandas resample overhead is ~2-3 ms; at fleet
+scale (10k members x 10 tags) that is the host-side staging bottleneck the
+TPU engine exposes (SURVEY.md §7 hard part 2: one process now feeds a whole
+model bank). This module replaces the per-tag loop for the default ``mean``
+aggregation with one numpy pass per tag:
+
+  bucket = floor(timestamp / resolution)        (int64 ns arithmetic)
+  sums   = bincount(bucket, weights=values)     (NaN-aware)
+  counts = bincount(bucket)
+  mean   = sums / counts                        (0/0 -> NaN, like pandas)
+
+and materializes the outer join directly as one column write per tag into a
+preallocated frame — no intermediate Series, no concat.
+
+Exact-parity constraints (verified in tests/test_resample.py):
+
+- Only ``aggregation == "mean"`` takes the fast path (the default and the
+  reference's documented aggregation); everything else uses pandas.
+- Only resolutions that evenly divide one day are eligible: pandas
+  ``resample`` uses ``origin='start_day'``, which coincides with epoch
+  flooring exactly when the step divides 24h (10min, 1min, 1h, 1d, ...)
+  and the index is UTC. Odd steps (7min, 1w) fall back to pandas.
+- Bucket range per tag spans floor(first kept sample)..floor(last kept
+  sample) — buckets with only-NaN samples bound the range but contribute
+  no mean (pandas semantics). The joined index is the sorted union of the
+  per-tag ranges; buckets covered by no tag are absent, buckets covered by
+  some tags carry NaN for the others.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+_DAY_NS = 86_400_000_000_000
+
+# Refuse to materialize absurd joined ranges (e.g. one stray 1970 timestamp
+# against 2020 data would ask for a 50-year bucket axis); pandas handles
+# that case slowly but safely, so hand it back.
+_MAX_BUCKETS = 20_000_000
+
+
+def _eligible_index(index: pd.Index) -> bool:
+    if not isinstance(index, pd.DatetimeIndex):
+        return False
+    if index.tz is None:
+        return True  # naive: treated as wall-clock == epoch-aligned days
+    return str(index.tz) == "UTC"
+
+
+def fused_mean_join(
+    series_list: List[pd.Series],
+    resampling_start: pd.Timestamp,
+    resampling_end: pd.Timestamp,
+    resolution: str,
+) -> Optional[Tuple[pd.DataFrame, Dict[str, Any]]]:
+    """Fused resample(mean)+outer-join. Returns None when ineligible
+    (caller falls back to the pandas path)."""
+    try:
+        res_ns = int(pd.Timedelta(resolution).value)
+    except ValueError:
+        return None
+    if res_ns <= 0 or _DAY_NS % res_ns != 0:
+        return None
+
+    start = pd.Timestamp(resampling_start)
+    start_ns = int(start.value)
+    end_ns = int(pd.Timestamp(resampling_end).value)
+    bounds_aware = start.tzinfo is not None
+
+    # pandas keeps duplicate columns through concat; a dict cannot — let
+    # the pandas path own that (misconfigured but well-defined) case
+    names = [s.name for s in series_list]
+    if len(set(names)) != len(names):
+        return None
+
+    meta: Dict[str, Any] = {}
+    cols: List[Tuple[Any, Any, int, np.ndarray]] = []  # (name, dtype, lo, mean)
+    tz = None
+    index_name = None
+    units = set()  # non-nano datetime units (pandas 2.x): preserved on output
+    aware_seen = naive_seen = False
+    for series in series_list:
+        name = series.name
+        meta[str(name)] = {"rows_raw": int(series.size)}
+        if series.empty:
+            # pandas appends the raw empty series (no resample, no bounds
+            # comparison) — its index still contributes tz/unit to concat
+            if isinstance(series.index, pd.DatetimeIndex):
+                units.add(getattr(series.index, "unit", "ns"))
+                if series.index.tz is not None:
+                    tz, aware_seen = "UTC", True
+                else:
+                    naive_seen = True
+            cols.append((name, series.dtype, -1, np.empty(0)))
+            continue
+        if not _eligible_index(series.index):
+            return None
+        # tz-ness must match the bounds: comparing naive indexes against
+        # aware bounds (or vice versa) raises TypeError in the pandas path
+        # — keep that loud failure instead of silently assuming UTC
+        if (series.index.tz is not None) != bounds_aware:
+            return None
+        if series.index.tz is not None:
+            tz, aware_seen = "UTC", True
+        else:
+            naive_seen = True
+        if index_name is None:
+            index_name = series.index.name
+
+        # asi8 is in the index's own unit (ns/us/ms/s in pandas 2.x);
+        # normalize to ns for the bucket arithmetic
+        units.add(getattr(series.index, "unit", "ns"))
+        ts = series.index.as_unit("ns").asi8
+        keep = (ts >= start_ns) & (ts < end_ns)
+        ts = ts[keep]
+        vals = np.asarray(series.values)[keep]
+        if ts.size == 0:
+            # out-of-window: the pandas path resamples an empty slice,
+            # which mean-widens the dtype (float32 stays, ints -> float64)
+            meta[str(name)]["rows_resampled"] = 0
+            out_dtype = (
+                series.dtype if series.dtype == np.float32 else np.float64
+            )
+            cols.append((name, out_dtype, -1, np.empty(0)))
+            continue
+
+        bucket = ts // res_ns
+        lo = int(bucket.min())
+        hi = int(bucket.max())
+        n = hi - lo + 1
+        if n > _MAX_BUCKETS:
+            return None
+        offs = (bucket - lo).astype(np.int64)
+        try:
+            fvals = vals.astype(np.float64, copy=False)
+        except (ValueError, TypeError):
+            # object/extension dtypes: let pandas define the behavior
+            return None
+        good = ~np.isnan(fvals)
+        counts = np.bincount(offs[good], minlength=n)
+        sums = np.bincount(offs[good], weights=fvals[good], minlength=n)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            mean = sums / counts  # count==0 -> NaN, matching pandas
+        # pandas preserves float32 through groupby-mean; ints widen to float64
+        out_dtype = series.dtype if series.dtype == np.float32 else np.float64
+        meta[str(name)]["rows_resampled"] = n
+        cols.append((name, out_dtype, lo, mean.astype(out_dtype, copy=False)))
+
+    if aware_seen and naive_seen:
+        # mixed tz-ness across series: pandas concat semantics are messy
+        # here — hand the case back rather than approximate them
+        return None
+    if len(units) > 1:
+        # mixed index units: concat's promotion rules are version-dependent
+        # (ns+s -> us on pandas 3) — hand the case back rather than guess
+        return None
+
+    # joined index = sorted union of per-tag bucket ranges
+    ranged = [(lo, lo + m.size) for (_, _, lo, m) in cols if m.size]
+    if not ranged:
+        # every tag empty/out-of-window: mirror the pandas path, whose
+        # concat of empty resampled series keeps an empty DatetimeIndex
+        # an empty DatetimeIndex defaults to the 's' unit — coerce to the
+        # inputs' unit (or ns) to match what the pandas path produces
+        unit = next(iter(units)) if len(units) == 1 else "ns"
+        index = pd.DatetimeIndex([], tz=tz, name=index_name).as_unit(unit)
+        df = pd.DataFrame(
+            {name: pd.Series(dtype=dt, index=index) for (name, dt, _, _) in cols},
+            index=index,
+        )
+        return df, meta
+    glo = min(lo for lo, _ in ranged)
+    ghi = max(end for _, end in ranged)
+    if ghi - glo > _MAX_BUCKETS:
+        return None
+    covered = np.zeros(ghi - glo, dtype=bool)
+    for lo, end in ranged:
+        covered[lo - glo : end - glo] = True
+    buckets = np.flatnonzero(covered) + glo
+
+    index = pd.DatetimeIndex(buckets * res_ns, tz=tz, name=index_name)
+    if len(units) == 1 and "ns" not in units:
+        index = index.as_unit(units.pop())
+    data = {}
+    for name, dtype, lo, mean in cols:
+        if mean.size == 0:
+            col = np.full(buckets.size, np.nan)
+        else:
+            # positions of the global buckets inside this tag's range
+            pos = buckets - lo
+            inside = (pos >= 0) & (pos < mean.size)
+            col = np.full(buckets.size, np.nan)
+            col[inside] = mean[pos[inside]]
+        # float32 survives reindex/outer-join in pandas (NaN fits), so keep it
+        out_dtype = dtype if dtype == np.float32 else np.float64
+        data[name] = col.astype(out_dtype, copy=False)
+    df = pd.DataFrame(data, index=index)
+    return df, meta

@@ -1,0 +1,19 @@
+"""Host-staging concurrency policy.
+
+One process stages data for a whole gang (SURVEY.md §7 hard part 2), so the
+member-loading pool size is an operator lever: ``GORDO_LOAD_WORKERS``
+overrides the default of ``min(8, cores)``. Shared by the fleet builder and
+``bench.py``'s host_pipeline metric so the benchmark measures the same
+concurrency a fleet build actually uses.
+"""
+
+import os
+
+
+def load_worker_count(n_tasks: int | None = None) -> int:
+    """Member-loading thread count: ``GORDO_LOAD_WORKERS`` or
+    ``min(8, cores)``, clamped to ``n_tasks`` when given."""
+    workers = int(os.environ.get("GORDO_LOAD_WORKERS", min(8, os.cpu_count() or 1)))
+    if n_tasks is not None:
+        workers = min(workers, n_tasks)
+    return max(1, workers)
