@@ -518,20 +518,24 @@ def run_metrics_child(skip: set, platform: str | None) -> None:
             print(f"METRIC {name} " + json.dumps(out), flush=True)
 
 
-def run_metrics_supervised(env_platform, detail, errors, skip):
+def run_metrics_supervised(env_platform, detail, errors, skip, child_cmd=None):
     """Run the metric suite in a supervised child.
 
     The parent enforces a stall watchdog: if the child produces no new
     metric line for STALL_SECONDS it is killed (a blocked recv never
     raises, so this is the only recovery). Returns the set of metric names
-    that completed."""
-    args = [sys.executable, os.path.abspath(__file__), "--child"]
-    if env_platform:
-        # passed as an argv flag and applied in-process by the child:
-        # JAX_PLATFORMS in the env hangs under the accelerator site hook
-        args += ["--platform", env_platform]
-    if skip:
-        args += ["--skip", ",".join(sorted(skip))]
+    that completed. ``child_cmd`` substitutes the child argv (tests drive
+    scripted children through the real supervisor with it)."""
+    if child_cmd is not None:
+        args = child_cmd
+    else:
+        args = [sys.executable, os.path.abspath(__file__), "--child"]
+        if env_platform:
+            # passed as an argv flag and applied in-process by the child:
+            # JAX_PLATFORMS in the env hangs under the accelerator site hook
+            args += ["--platform", env_platform]
+        if skip:
+            args += ["--skip", ",".join(sorted(skip))]
     proc = subprocess.Popen(
         args,
         stdout=subprocess.PIPE,

@@ -1,0 +1,101 @@
+"""Supervisor tests for ``bench.py``'s unattended-run machinery.
+
+The driver runs ``bench.py`` exactly once per round on hardware nobody is
+watching; the supervisor must convert every child failure mode — clean
+exit, silent wedge, crash mid-write — into recorded errors plus whatever
+partial results exist. Children here are scripted Python one-liners driven
+through the real ``run_metrics_supervised`` loop.
+"""
+
+import importlib.util
+import os
+import sys
+import time
+
+import pytest
+
+BENCH_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(bench, script, stall=None):
+    if stall is not None:
+        old = bench.STALL_SECONDS
+        bench.STALL_SECONDS = stall
+    detail, errors = {}, {}
+    t0 = time.time()
+    try:
+        done = bench.run_metrics_supervised(
+            None, detail, errors, set(), child_cmd=[sys.executable, "-c", script]
+        )
+    finally:
+        if stall is not None:
+            bench.STALL_SECONDS = old
+    return done, detail, errors, time.time() - t0
+
+
+def test_clean_child_collects_all_lines_without_dead_wait(bench):
+    script = (
+        "print('METRIC_START fleet', flush=True);"
+        "print('METRIC fleet {\"fleet_models_per_hour_per_chip\": 42.0}', flush=True);"
+        "print('METRIC sequential {\"sequential_models_per_hour_per_chip\": 2.0}', flush=True)"
+    )
+    done, detail, errors, elapsed = _run(bench, script)
+    assert done == {"fleet", "sequential"}
+    assert detail["fleet_models_per_hour_per_chip"] == 42.0
+    assert errors == {}
+    # regression: a clean exit must not be mistaken for a stall and sat on
+    assert elapsed < bench.STALL_SECONDS / 2
+
+
+def test_metric_error_lines_recorded_per_metric(bench):
+    script = (
+        "print('METRIC_ERROR {\"name\": \"fleet\", \"error\": \"RuntimeError: boom\"}',"
+        " flush=True);"
+        "print('METRIC sequential {\"ok\": 1}', flush=True)"
+    )
+    done, detail, errors, _ = _run(bench, script)
+    assert done == {"fleet", "sequential"}
+    assert "boom" in errors["fleet"]
+    assert detail == {"ok": 1}
+
+
+def test_wedged_child_is_killed_and_attributed(bench):
+    script = (
+        "import time;"
+        "print('METRIC fleet {\"fleet_models_per_hour_per_chip\": 1.0}', flush=True);"
+        "print('METRIC_START sequential', flush=True);"
+        "time.sleep(600)"
+    )
+    done, detail, errors, elapsed = _run(bench, script, stall=2)
+    assert done == {"fleet"}  # partial results survive the kill
+    assert detail["fleet_models_per_hour_per_chip"] == 1.0
+    assert "stall:sequential" in errors  # blamed on the announced metric
+    assert elapsed < 30
+
+
+def test_crash_mid_write_keeps_partial_results(bench):
+    script = (
+        "import sys;"
+        "print('METRIC fleet {\"fleet_models_per_hour_per_chip\": 7.0}', flush=True);"
+        "sys.stdout.write('METRIC sequential {\"trunca'); sys.stdout.flush();"
+        "sys.exit(139)"
+    )
+    done, detail, errors, _ = _run(bench, script)
+    assert "fleet" in done
+    assert detail["fleet_models_per_hour_per_chip"] == 7.0
+    assert "malformed_line" in errors
+    assert "rc=139" in errors["child_exit"]
+
+
+def test_abnormal_exit_without_output_is_recorded(bench):
+    done, detail, errors, _ = _run(bench, "import sys; sys.exit(3)")
+    assert done == set()
+    assert "rc=3" in errors["child_exit"]
