@@ -72,9 +72,9 @@ class _BankEntry:
 def _affine_from_scaler(step, n_features: int):
     """Return (shift, scale) arrays for a supported scaler step, or None.
 
-    Supports the JAX scalers (already affine) and sklearn's MinMaxScaler /
-    StandardScaler (converted: sklearn minmax ``x*scale_ + min_`` ==
-    ``(x - (-min_/scale_)) * scale_``).
+    Supports the JAX scalers (already affine) and sklearn's affine family —
+    MinMaxScaler (``x*scale_ + min_`` == ``(x - (-min_/scale_)) * scale_``),
+    StandardScaler, RobustScaler, MaxAbsScaler.
     """
     params = getattr(step, "scaler_params_", None)
     if params is not None:  # JaxMinMaxScaler / JaxStandardScaler
@@ -83,16 +83,23 @@ def _affine_from_scaler(step, n_features: int):
     if cls == "MinMaxScaler" and getattr(step, "scale_", None) is not None:
         scale = np.asarray(step.scale_, np.float32)
         return (-np.asarray(step.min_, np.float32) / scale), scale
-    if cls == "StandardScaler" and hasattr(step, "scale_"):
-        mean = getattr(step, "mean_", None)
+    # StandardScaler/RobustScaler both compute (x - shift) / scale_, with
+    # the respective attribute set to None when centering/scaling is off
+    shift_attr = {"StandardScaler": "mean_", "RobustScaler": "center_"}.get(cls)
+    if shift_attr and hasattr(step, "scale_"):
+        center = getattr(step, shift_attr, None)
         shift = np.asarray(
-            mean if mean is not None else np.zeros(n_features), np.float32
+            center if center is not None else np.zeros(n_features), np.float32
         )
-        # with_std=False leaves scale_ = None: a pure-centering affine
         scale_ = step.scale_
         if scale_ is None:
             return shift, np.ones((n_features,), np.float32)
         return shift, 1.0 / np.asarray(scale_, np.float32)
+    if cls == "MaxAbsScaler" and getattr(step, "scale_", None) is not None:
+        return (
+            np.zeros((n_features,), np.float32),
+            1.0 / np.asarray(step.scale_, np.float32),
+        )
     return None
 
 

@@ -9,7 +9,7 @@ import numpy as np
 import pandas as pd
 import pytest
 from sklearn.pipeline import Pipeline
-from sklearn.preprocessing import MinMaxScaler
+from sklearn.preprocessing import MaxAbsScaler, MinMaxScaler, RobustScaler
 
 from gordo_components_tpu.models import (
     AutoEncoder,
@@ -170,6 +170,30 @@ async def test_batching_engine_propagates_errors(fleet_models):
         assert isinstance(bad, ValueError)
     finally:
         await engine.stop()
+
+
+@pytest.mark.parametrize(
+    "make_scaler",
+    [
+        lambda: RobustScaler(),
+        lambda: RobustScaler(with_centering=False),
+        lambda: RobustScaler(with_scaling=False),
+        lambda: MaxAbsScaler(),
+    ],
+    ids=["robust", "robust-no-center", "robust-no-scale", "maxabs"],
+)
+def test_bank_affine_scaler_family(make_scaler):
+    """RobustScaler/MaxAbsScaler are affine: the bank must reproduce the
+    per-model scoring exactly, not fall back."""
+    rng = np.random.RandomState(7)
+    X = (rng.rand(150, 4).astype("float32") - 0.3) * 5.0
+    det = _make_det(X, scaler=make_scaler())
+    bank = ModelBank.from_models({"m": det})
+    cov = bank.coverage()
+    assert cov["banked"] == 1 and "m" not in cov["fallback"], cov
+    expected = det.anomaly(X[:41])
+    got = bank.score("m", X[:41]).to_frame()
+    pd.testing.assert_frame_equal(got, expected, rtol=1e-4, atol=1e-5)
 
 
 def test_bank_standard_scaler_without_std(fleet_models):
