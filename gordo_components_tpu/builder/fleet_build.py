@@ -46,6 +46,10 @@ _SCALER_PATHS = (
     "sklearn.preprocessing.MinMaxScaler",
     "gordo_components_tpu.models.transformers.JaxMinMaxScaler",
 )
+_STANDARD_SCALER_PATHS = (
+    "sklearn.preprocessing.StandardScaler",
+    "gordo_components_tpu.models.transformers.JaxStandardScaler",
+)
 
 # AutoEncoder kwargs the fleet path honors with semantics identical to the
 # single-build path: FleetTrainer's own training knobs (including
@@ -59,6 +63,10 @@ _TRAINER_KEYS = frozenset(
         "validation_split", "seed", "compute_dtype", "quantize_rows",
     }
 )
+# NOTE: "input_scaler" is deliberately NOT in _TRAINER_KEYS: it is injected
+# by extract_fleetable from the pipeline's scaler STEP, never accepted as a
+# user-supplied AutoEncoder kwarg (which must fail the fleetable check and
+# then fail loudly on the single-build path).
 _FACTORY_KEYS = frozenset(
     {
         "encoding_dim", "decoding_dim", "encoding_func", "decoding_func",
@@ -70,14 +78,17 @@ _FACTORY_KEYS = frozenset(
 
 def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """If ``model_config`` is EXACTLY the canonical anomaly pipeline —
-    ``DiffBasedAnomalyDetector(base_estimator=Pipeline(MinMaxScaler,
-    AutoEncoder))`` with no other detector kwargs — return the AutoEncoder
-    kwargs for FleetTrainer; else None (single-build path).
+    ``DiffBasedAnomalyDetector(base_estimator=Pipeline(scaler,
+    AutoEncoder))`` with no other detector kwargs and a default-kwargs
+    MinMax/Standard scaler step — return the AutoEncoder kwargs for
+    FleetTrainer (plus ``input_scaler="standard"`` for the z-score
+    variant); else None (single-build path).
 
-    The check is deliberately strict: the fleet engine always min-max
-    scales inputs and builds a default detector, so any config that
-    deviates (extra detector kwargs, no scaler step, bare base estimator)
-    must take the single-build path to keep identical semantics.
+    The check is deliberately strict: the fleet engine fits exactly the
+    default min-max or z-score affine and builds a default detector, so
+    any config that deviates (extra detector kwargs, scaler kwargs, no
+    scaler step, bare base estimator) must take the single-build path to
+    keep identical semantics.
     """
     if not isinstance(model_config, dict) or len(model_config) != 1:
         return None
@@ -99,10 +110,17 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         if isinstance(s, (list, tuple)) and len(s) == 2:
             s = s[1]
         inner.append(s)
+    scaler_kind = None
     if len(inner) == 2 and _is_path(inner[0], _SCALER_PATHS):
+        scaler_kind = "minmax"
+    elif len(inner) == 2 and _is_path(inner[0], _STANDARD_SCALER_PATHS):
+        scaler_kind = "standard"
+    if scaler_kind is not None:
         ae = _ae_kwargs(inner[1])
         if ae is not None and set(ae) - (_TRAINER_KEYS | _FACTORY_KEYS):
             return None  # kwargs the trainer can't honor identically
+        if ae is not None and scaler_kind != "minmax":
+            ae = dict(ae, input_scaler=scaler_kind)
         return ae
     return None
 

@@ -37,6 +37,7 @@ from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.ops.scaler import (
     ScalerParams,
     fit_minmax,
+    fit_standard,
     scaler_transform,
 )
 from gordo_components_tpu.parallel.mesh import (
@@ -58,10 +59,11 @@ logger = logging.getLogger(__name__)
 # dataclasses, so equal-config modules hash equal and share an entry.
 
 
-@jax.jit
-def _fit_scalers(X, mask):
+@functools.partial(jax.jit, static_argnames="kind")
+def _fit_scalers(X, mask, kind="minmax"):
     Xn = jnp.where(mask[..., None] > 0, X, jnp.nan)
-    return jax.vmap(fit_minmax)(Xn)
+    fit = fit_minmax if kind == "minmax" else fit_standard
+    return jax.vmap(fit)(Xn)
 
 
 @jax.jit
@@ -267,6 +269,7 @@ class FleetMemberModel:
     tags: Optional[List[str]] = None  # feature/tag names, when known
     feature_thresholds: Optional[np.ndarray] = None  # max scaled train error
     total_threshold: Optional[float] = None
+    scaler_kind: str = "minmax"  # which fit produced ``scaler``
 
     def _module(self):
         factory = lookup_factory("AutoEncoder", self.kind)
@@ -283,19 +286,27 @@ class FleetMemberModel:
         )
 
     def to_estimator(self):
-        """Convert to a fitted sklearn-style Pipeline(JaxMinMaxScaler, AutoEncoder)
-        wrapped in a DiffBasedAnomalyDetector — artifact/server compatible."""
+        """Convert to a fitted sklearn-style Pipeline(scaler, AutoEncoder)
+        wrapped in a DiffBasedAnomalyDetector — artifact/server compatible.
+        The scaler class mirrors what the trainer fitted (min-max or
+        z-score) so artifact metadata round-trips honestly."""
         from sklearn.pipeline import Pipeline
 
         from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
-        from gordo_components_tpu.models.transformers import JaxMinMaxScaler
+        from gordo_components_tpu.models.transformers import (
+            JaxMinMaxScaler,
+            JaxStandardScaler,
+        )
 
         est = AutoEncoder(kind=self.kind, **self.factory_kwargs)
         est.params_ = self.params
         est.n_features_ = self.n_features
         est.history = dict(self.history)
 
-        scaler = JaxMinMaxScaler()
+        scaler = (
+            JaxStandardScaler() if self.scaler_kind == "standard"
+            else JaxMinMaxScaler()
+        )
         scaler.set_fitted(ScalerParams(*self.scaler), self.n_features)
 
         pipe = Pipeline([("scale", scaler), ("model", est)])
@@ -336,6 +347,7 @@ class FleetTrainer:
         epoch_callback=None,
         host_sync_every: int = 1,
         quantize_rows: bool = True,
+        input_scaler: str = "minmax",
         **factory_kwargs,
     ):
         self.kind = kind
@@ -353,6 +365,11 @@ class FleetTrainer:
         self.seed = int(seed)
         self.mesh = mesh
         self.compute_dtype = compute_dtype
+        # per-member input scaling fitted on device: "minmax" (the
+        # reference's default pipeline) or "standard" (z-score)
+        if input_scaler not in ("minmax", "standard"):
+            raise ValueError(f"input_scaler must be minmax|standard, got {input_scaler!r}")
+        self.input_scaler = input_scaler
         # preemption recovery: when set, stacked train state is checkpointed
         # every ``checkpoint_every`` epochs and fit() resumes a matching
         # interrupted run (parallel/checkpoint.py)
@@ -505,7 +522,7 @@ class FleetTrainer:
 
         # ---- per-member scalers, fitted on device (masked rows excluded
         # by writing NaNs, which the nan-aware fit ignores) ----
-        scalers = _fit_scalers(Xd, maskd)
+        scalers = _fit_scalers(Xd, maskd, self.input_scaler)
         Xd = _transform_all(scalers, Xd)
         # padded rows were NaN-protected during fit; re-zero them post-scale
         Xd = jnp.where(maskd[..., None] > 0, Xd, 0.0)
@@ -558,6 +575,7 @@ class FleetTrainer:
                     self.kind,
                     sorted(self.factory_kwargs.items()),
                     self.compute_dtype,
+                    self.input_scaler,
                     n_features,
                     padded_rows,
                     list(names),
@@ -837,6 +855,7 @@ class FleetTrainer:
                 tags=self._tags_map.get(name),
                 feature_thresholds=feat_thresh[i],
                 total_threshold=float(total_thresh[i]),
+                scaler_kind=self.input_scaler,
             )
         # clear only once results are unstacked on host: a preemption during
         # the error-scaler pass / unstacking above can still resume from the

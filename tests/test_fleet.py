@@ -119,6 +119,34 @@ class TestFleetTrainer:
         single_final = single.history["loss"][-1]
         assert fleet_final == pytest.approx(single_final, rel=1.0)  # same ballpark
 
+    def test_standard_input_scaler_matches_sklearn(self):
+        """input_scaler='standard' must fit the same per-member z-score
+        affine sklearn's StandardScaler computes, and the unstacked
+        estimator must carry a JaxStandardScaler."""
+        from sklearn.preprocessing import StandardScaler
+
+        from gordo_components_tpu.models.transformers import JaxStandardScaler
+
+        members = _member_data(3)
+        trainer = FleetTrainer(
+            kind="feedforward_symmetric", dims=(8,), epochs=2, batch_size=64,
+            input_scaler="standard",
+        )
+        models = trainer.fit(members)
+        for name, X in members.items():
+            sk = StandardScaler().fit(X)
+            m = models[name]
+            np.testing.assert_allclose(m.scaler.shift, sk.mean_, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                m.scaler.scale, 1.0 / sk.scale_, rtol=1e-4, atol=1e-5
+            )
+            det = m.to_estimator()
+            assert isinstance(det.base_estimator.steps[0][1], JaxStandardScaler)
+
+    def test_invalid_input_scaler_rejected(self):
+        with pytest.raises(ValueError, match="minmax|standard"):
+            FleetTrainer(input_scaler="robust")
+
     def test_to_estimator_produces_anomaly_detector(self, sensor_frame):
         members = {"m": sensor_frame.values}
         trainer = FleetTrainer(

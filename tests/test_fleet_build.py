@@ -47,6 +47,68 @@ class TestExtractFleetable:
         assert kwargs["kind"] == "feedforward_symmetric"
         assert kwargs["epochs"] == 2
 
+    def test_standard_scaler_fleetable(self):
+        for path in (
+            "sklearn.preprocessing.StandardScaler",
+            "gordo_components_tpu.models.transformers.JaxStandardScaler",
+        ):
+            config = {
+                "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        "sklearn.pipeline.Pipeline": {
+                            "steps": [
+                                path,
+                                {
+                                    "gordo_components_tpu.models.AutoEncoder": {
+                                        "epochs": 2, "batch_size": 64,
+                                    }
+                                },
+                            ]
+                        }
+                    }
+                }
+            }
+            kwargs = extract_fleetable(config)
+            assert kwargs is not None and kwargs["input_scaler"] == "standard"
+
+    def test_user_supplied_input_scaler_kwarg_not_fleetable(self):
+        # input_scaler is an internal injection from the scaler STEP; a
+        # user writing it as an AutoEncoder kwarg must not sneak a
+        # different scaling past the declared pipeline
+        config = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_components_tpu.models.AutoEncoder": {
+                                    "input_scaler": "standard",
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+        assert extract_fleetable(config) is None
+
+    def test_standard_scaler_with_kwargs_not_fleetable(self):
+        # with_mean/with_std overrides deviate from the fleet's z-score fit
+        config = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            {"sklearn.preprocessing.StandardScaler": {"with_mean": False}},
+                            "gordo_components_tpu.models.AutoEncoder",
+                        ]
+                    }
+                }
+            }
+        }
+        assert extract_fleetable(config) is None
+
     def test_bespoke_config_not_fleetable(self):
         bespoke = {
             "gordo_components_tpu.models.LSTMAutoEncoder": {"lookback_window": 8}
