@@ -449,8 +449,45 @@ def bench_host_pipeline(n_members=32, n_tags=10, days=30):
     }
 
 
+def bench_lstm_fleet(
+    n_models=256, rows=720, n_features=10, lookback=32, epochs=3,
+    batch_size=128,
+):
+    """Config 2 at fleet scale — many-model LSTM training with
+    gather-windowed gang programs (windows stay views; HBM holds raw rows
+    only). Compare against lstm_models_per_hour_per_chip (the single-build
+    rate) for the sequence-fleet speedup."""
+    import jax
+
+    from gordo_components_tpu.parallel import FleetTrainer
+
+    members = _synth_fleet(n_models, rows, n_features)
+    config = dict(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(16,),
+        lookback_window=lookback, epochs=epochs, batch_size=batch_size,
+        compute_dtype="bfloat16", host_sync_every=epochs,
+    )
+    FleetTrainer(**config).fit(members)  # warm the programs
+    trainer = FleetTrainer(**config)
+    t0 = time.time()
+    trainer.fit(members)
+    elapsed = time.time() - t0
+    n_chips = len(jax.devices())
+    return {
+        "lstm_fleet_models_per_hour_per_chip": round(
+            n_models / elapsed * 3600 / n_chips, 1
+        ),
+        "lstm_fleet_wall_seconds": round(elapsed, 2),
+        "lstm_fleet_config": (
+            f"{n_models} models x {rows} rows x {n_features} tags, "
+            f"lstm_symmetric(16), lookback {lookback}, {epochs} epochs, bf16"
+        ),
+    }
+
+
 METRICS = (
     ("fleet", bench_fleet),
+    ("lstm_fleet", bench_lstm_fleet),
     ("sequential", bench_single_sequential),
     ("server_scoring", bench_server_scoring),
     ("bank_serving", bench_bank_serving),
@@ -467,6 +504,7 @@ METRICS = (
 # metric's own config/size fields record what actually ran.
 CPU_KWARGS = {
     "fleet": dict(n_models=256, epochs=3),
+    "lstm_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
     "sequential": dict(epochs=3, n_probe=2),
     "model_zoo": dict(rows=720, epochs=2),
     "checkpoint": dict(n_models=64, epochs=3),

@@ -37,6 +37,20 @@ _AE_PATHS = (
     "gordo_components_tpu.models.models.AutoEncoder",
     "gordo_components.model.models.KerasAutoEncoder",
 )
+# sequence families the fleet engine also gang-trains (gather-windowed
+# programs, parallel/fleet.py); reference-era aliases included
+_SEQ_PATHS = {
+    "LSTMAutoEncoder": (
+        "gordo_components_tpu.models.LSTMAutoEncoder",
+        "gordo_components_tpu.models.models.LSTMAutoEncoder",
+        "gordo_components.model.models.KerasLSTMAutoEncoder",
+    ),
+    "LSTMForecast": (
+        "gordo_components_tpu.models.LSTMForecast",
+        "gordo_components_tpu.models.models.LSTMForecast",
+        "gordo_components.model.models.KerasLSTMForecast",
+    ),
+}
 _DET_PATHS = (
     "gordo_components_tpu.models.DiffBasedAnomalyDetector",
     "gordo_components_tpu.models.anomaly.DiffBasedAnomalyDetector",
@@ -116,11 +130,19 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     elif len(inner) == 2 and _is_path(inner[0], _STANDARD_SCALER_PATHS):
         scaler_kind = "standard"
     if scaler_kind is not None:
-        ae = _ae_kwargs(inner[1])
-        if ae is not None and set(ae) - (_TRAINER_KEYS | _FACTORY_KEYS):
+        est = _estimator_kwargs(inner[1])
+        if est is None:
+            return None
+        model_type, ae = est
+        honored = _TRAINER_KEYS | _FACTORY_KEYS
+        if model_type != "AutoEncoder":
+            honored = honored | {"lookback_window"}
+        if set(ae) - honored:
             return None  # kwargs the trainer can't honor identically
-        if ae is not None and scaler_kind != "minmax":
+        if scaler_kind != "minmax":
             ae = dict(ae, input_scaler=scaler_kind)
+        if model_type != "AutoEncoder":
+            ae = dict(ae, model_type=model_type)
         return ae
     return None
 
@@ -137,13 +159,21 @@ def _is_path(defn, paths) -> bool:
     return False
 
 
-def _ae_kwargs(defn) -> Optional[Dict[str, Any]]:
+def _estimator_kwargs(defn) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """(model_type, kwargs) for a recognized estimator definition, else
+    None. model_type is the registry namespace FleetTrainer trains."""
     if isinstance(defn, str):
-        return {} if defn in _AE_PATHS else None
-    if isinstance(defn, dict) and len(defn) == 1:
+        path, kwargs = defn, {}
+    elif isinstance(defn, dict) and len(defn) == 1:
         (path, kwargs), = defn.items()
-        if path in _AE_PATHS:
-            return dict(kwargs or {})
+        kwargs = dict(kwargs or {})
+    else:
+        return None
+    if path in _AE_PATHS:
+        return "AutoEncoder", kwargs
+    for model_type, paths in _SEQ_PATHS.items():
+        if path in paths:
+            return model_type, kwargs
     return None
 
 

@@ -173,6 +173,115 @@ def make_train_fns(
     return init_fn, epoch_fn
 
 
+def make_seq_train_fns(
+    module,
+    optimizer: optax.GradientTransformation,
+    batch_size: int,
+    lookback: int,
+    target_offset: int = 0,
+    loss: str = "mse",
+    kl_weight: float = 1.0,
+):
+    """Sequence-model variant of :func:`make_train_fns` where windows are
+    GATHERED per batch instead of materialized.
+
+    The single-model path materializes ``(n_windows, lookback, f)`` host-side
+    and feeds :func:`make_train_fns`; at fleet scale that costs ``lookback``x
+    the HBM of the raw rows. Here the epoch program keeps only the raw
+    ``(rows, f)`` member block on device and the scan body gathers each
+    batch's windows (``X[i : i+lookback]``) on the fly — numerically
+    identical (window *i* holds the same rows either way, the shuffle/rng
+    scheme is byte-for-byte the one in ``make_train_fns``), but HBM stays
+    O(rows) per member.
+
+    - ``init_fn(rng, sample_w) -> TrainState`` (sample_w: one (lookback, f)
+      window for shape inference)
+    - ``epoch_fn(state, X, Y, mask) -> (state, mean_loss)``: X is the raw
+      padded ``(rows_pad, f)`` block; Y is IGNORED (targets derive from X:
+      item *i* trains window ``[i, i+lookback)`` against row
+      ``i + lookback - 1 + target_offset``); mask is the (items_pad,) item
+      validity mask, items_pad a multiple of ``batch_size``.
+    """
+    loss_fn = make_loss_fn(module, loss=loss, kl_weight=kl_weight)
+    t_off = lookback - 1 + target_offset
+
+    def init_fn(rng: jax.Array, sample_w: jnp.ndarray) -> TrainState:
+        init_rng, state_rng = jax.random.split(rng)
+        params = module.init(init_rng, sample_w[None, ...])
+        opt_state = optimizer.init(params)
+        return TrainState(params=params, opt_state=opt_state, rng=state_rng)
+
+    def epoch_fn(state: TrainState, X, Y, mask):
+        del Y  # targets are rows of X (reconstruction/forecast)
+        n_pad = mask.shape[0]
+        n_batches = n_pad // batch_size
+        rng, perm_rng, batch_base = jax.random.split(state.rng, 3)
+        rngs = jax.vmap(lambda i: jax.random.fold_in(batch_base, i))(
+            jnp.arange(n_batches)
+        )
+        keys = jax.random.uniform(perm_rng, (n_pad,))
+        perm = jnp.argsort(jnp.where(mask > 0, keys, 2.0))
+        idxs = perm.reshape((n_batches, batch_size))
+        Ms = mask[perm].reshape((n_batches, batch_size))
+        rows = X.shape[0]
+        win_off = jnp.arange(lookback)
+
+        def step(carry, batch):
+            params, opt_state = carry
+            ib, mb, brng = batch
+            # padded items gather clipped garbage; their mask zeroes them out
+            widx = jnp.clip(ib[:, None] + win_off[None, :], 0, rows - 1)
+            xb = X[widx]  # (batch, lookback, f)
+            yb = X[jnp.clip(ib + t_off, 0, rows - 1)]
+            loss_val, grads = jax.value_and_grad(loss_fn)(params, brng, xb, yb, mb)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            has_real = jnp.sum(mb) > 0
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(has_real, n, o), new, old
+            )
+            return (keep(new_params, params), keep(new_opt_state, opt_state)), (
+                loss_val,
+                jnp.sum(mb),
+            )
+
+        (params, opt_state), (losses, counts) = jax.lax.scan(
+            step, (state.params, state.opt_state), (idxs, Ms, rngs)
+        )
+        mean_loss = jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
+        return TrainState(params=params, opt_state=opt_state, rng=rng), mean_loss
+
+    return init_fn, epoch_fn
+
+
+def make_seq_eval_fn(module, batch_size: int, lookback: int, target_offset: int = 0):
+    """``eval_fn(params, X, item_mask) -> mean_loss`` over gathered windows
+    (validation loss for sequence fleet members), scan-chunked so HBM never
+    holds more than one batch of materialized windows."""
+    t_off = lookback - 1 + target_offset
+
+    def eval_fn(params, X, mask):
+        n_pad = mask.shape[0]
+        n_batches = n_pad // batch_size
+        idxs = jnp.arange(n_pad).reshape((n_batches, batch_size))
+        Ms = mask.reshape((n_batches, batch_size))
+        rows = X.shape[0]
+        win_off = jnp.arange(lookback)
+
+        def step(_, batch):
+            ib, mb = batch
+            widx = jnp.clip(ib[:, None] + win_off[None, :], 0, rows - 1)
+            pred = module.apply(params, X[widx])
+            yb = X[jnp.clip(ib + t_off, 0, rows - 1)]
+            se = jnp.sum((pred - yb) ** 2, axis=-1) / pred.shape[-1]
+            return None, (jnp.sum(se * mb), jnp.sum(mb))
+
+        _, (sums, counts) = jax.lax.scan(step, None, (idxs, Ms))
+        return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1.0)
+
+    return eval_fn
+
+
 def make_eval_fn(module, batch_size: int, loss: str = "mse", kl_weight: float = 1.0):
     """``eval_fn(state, X, Y, mask) -> mean_loss`` over padded data, no
     parameter update (validation loss / early stopping)."""

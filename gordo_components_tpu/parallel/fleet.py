@@ -89,12 +89,25 @@ def _merge_best(best_p, new_p, improved):
 
 
 class _BucketPrograms:
-    """All compiled programs for one (module, optimizer, batch-size) key."""
+    """All compiled programs for one (module, optimizer, batch-size[, seq])
+    key. ``seq=(lookback, target_offset)`` switches every program to the
+    gather-windowed sequence variants: X stays the raw (rows_pad, f) member
+    block on device and masks index ITEMS (window starts), so sequence
+    fleets train with O(rows) HBM per member instead of O(rows*lookback)."""
 
-    def __init__(self, module, opt_name: str, lr: float, batch_size: int):
+    def __init__(self, module, opt_name: str, lr: float, batch_size: int, seq=None):
         self.module = module
+        self.seq = seq
         optimizer = train_core.make_optimizer(opt_name, lr)
-        init_fn, epoch_fn = train_core.make_train_fns(module, optimizer, batch_size)
+        if seq is None:
+            init_fn, epoch_fn = train_core.make_train_fns(
+                module, optimizer, batch_size
+            )
+        else:
+            lookback, t_offset = seq
+            init_fn, epoch_fn = train_core.make_seq_train_fns(
+                module, optimizer, batch_size, lookback, t_offset
+            )
         self.init_stacked = jax.jit(jax.vmap(init_fn))
 
         def masked_epoch(state, X, mask, active):
@@ -107,19 +120,33 @@ class _BucketPrograms:
         self._vm_epoch = jax.vmap(masked_epoch)
         self.run_epoch = jax.jit(jax.vmap(masked_epoch), donate_argnums=(0,))
 
-        # per-member validation loss: one masked forward over all rows —
-        # the same global masked mean eval_fn computes batchwise in the
-        # single-model path (models/models.py), so fleet val-loss ES has
-        # identical semantics to BaseEstimator.fit's
+        # per-member validation loss: the same global masked mean eval_fn
+        # computes batchwise in the single-model path (models/models.py),
+        # so fleet val-loss ES has identical semantics to BaseEstimator.fit's
         from gordo_components_tpu.ops.losses import mse_loss
 
-        def member_val_loss(params, x, vmask):
-            pred = module.apply(params, x)
-            return mse_loss(pred, x, vmask)
+        if seq is None:
+
+            def member_val_loss(params, x, vmask):
+                pred = module.apply(params, x)
+                return mse_loss(pred, x, vmask)
+
+        else:
+            member_val_loss = train_core.make_seq_eval_fn(
+                module, batch_size, seq[0], seq[1]
+            )
 
         self._vm_eval = jax.vmap(member_val_loss)
         self.eval_stacked = jax.jit(self._vm_eval)
+        self.fit_error_scalers = (
+            self._make_error_scalers(module)
+            if seq is None
+            else self._make_seq_error_scalers(module, batch_size, *seq)
+        )
+        self._chunks: Dict[Tuple, Any] = {}
 
+    @staticmethod
+    def _make_error_scalers(module):
         @jax.jit
         def fit_error_scalers(params, X, mask):
             def one(p, x, m):
@@ -135,8 +162,73 @@ class _BucketPrograms:
 
             return jax.vmap(one)(params, X, mask)
 
-        self.fit_error_scalers = fit_error_scalers
-        self._chunks: Dict[Tuple, Any] = {}
+        return fit_error_scalers
+
+    @staticmethod
+    def _make_seq_error_scalers(module, batch_size, lookback, t_offset):
+        """Two scan passes (min/max of |err|, then scaled thresholds) so
+        windows are never materialized beyond one batch — the same anomaly
+        contract as the dense path: es = minmax over training |err|,
+        feature thresholds = max scaled |err|, total = max scaled norm."""
+        toff = lookback - 1 + t_offset
+
+        @jax.jit
+        def fit_error_scalers(params, X, mask):
+            def one(p, x, m):
+                n_pad = m.shape[0]
+                nb = n_pad // batch_size
+                idxs = jnp.arange(n_pad).reshape((nb, batch_size))
+                Ms = m.reshape((nb, batch_size))
+                rows = x.shape[0]
+                woff = jnp.arange(lookback)
+
+                def diff_batch(ib, mb):
+                    widx = jnp.clip(ib[:, None] + woff[None, :], 0, rows - 1)
+                    pred = module.apply(p, x[widx])
+                    yb = x[jnp.clip(ib + toff, 0, rows - 1)]
+                    d = jnp.abs(yb - pred)
+                    return jnp.where(mb[..., None] > 0, d, jnp.nan)
+
+                def pass1(carry, batch):
+                    lo, hi = carry
+                    d = diff_batch(*batch)
+                    return (
+                        jnp.fmin(lo, jnp.nanmin(d, axis=0)),
+                        jnp.fmax(hi, jnp.nanmax(d, axis=0)),
+                    ), None
+
+                f = x.shape[-1]
+                (dmin, dmax), _ = jax.lax.scan(
+                    pass1,
+                    (jnp.full((f,), jnp.inf), jnp.full((f,), -jnp.inf)),
+                    (idxs, Ms),
+                )
+                # mirror fit_minmax's (0,1) affine incl. the constant guard
+                span = jnp.where(jnp.abs(dmax - dmin) < 1e-12, 1.0, dmax - dmin)
+                es = ScalerParams(shift=dmin, scale=1.0 / span)
+
+                def pass2(carry, batch):
+                    ft, tt = carry
+                    d = diff_batch(*batch)
+                    scaled = scaler_transform(es, d)
+                    total = jnp.sqrt(jnp.nansum(scaled**2, axis=-1))
+                    # all-NaN (padded) rows: nansum=0 -> exclude via mask
+                    total = jnp.where(jnp.isnan(d).all(axis=-1), jnp.nan, total)
+                    return (
+                        jnp.fmax(ft, jnp.nanmax(scaled, axis=0)),
+                        jnp.fmax(tt, jnp.nanmax(total)),
+                    ), None
+
+                (feat_thresh, total_thresh), _ = jax.lax.scan(
+                    pass2,
+                    (jnp.full((f,), -jnp.inf), jnp.float32(-jnp.inf)),
+                    (idxs, Ms),
+                )
+                return es, feat_thresh, total_thresh
+
+            return jax.vmap(one)(params, X, mask)
+
+        return fit_error_scalers
 
     def chunk_fn(self, K: int, es_enabled: bool, es_p0, delta, use_val: bool = False):
         """K-epoch device chunk with (optional) on-device early stopping,
@@ -238,19 +330,39 @@ def quantize_batch_count(n: int) -> int:
             return p
 
 
+# model families the fleet engine trains
+_MODEL_TYPES = ("AutoEncoder", "LSTMAutoEncoder", "LSTMForecast")
+
+
+def _target_offset_for(model_type: str) -> Optional[int]:
+    """Target offset for a sequence family, None for the dense family.
+
+    Read from the estimator class's ``_target_offset`` (models/models.py) —
+    the same attribute the bank and anomaly paths consult — so the offset
+    semantics have exactly one source of truth."""
+    if model_type == "AutoEncoder":
+        return None
+    from gordo_components_tpu import models as _models
+
+    return int(getattr(_models, model_type)._target_offset)
+
 _PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
 
 
-def _bucket_programs(module, opt_name: str, lr: float, batch_size: int) -> _BucketPrograms:
-    key = (module, opt_name, float(lr), int(batch_size))
+def _bucket_programs(
+    module, opt_name: str, lr: float, batch_size: int, seq=None
+) -> _BucketPrograms:
+    key = (module, opt_name, float(lr), int(batch_size), seq)
     try:
         prog = _PROGRAM_CACHE.get(key)
     except TypeError:  # unhashable factory kwargs: build uncached
-        return _BucketPrograms(module, opt_name, lr, batch_size)
+        return _BucketPrograms(module, opt_name, lr, batch_size, seq)
     if prog is None:
         if len(_PROGRAM_CACHE) >= 128:  # bound on pathological churn
             _PROGRAM_CACHE.clear()
-        prog = _PROGRAM_CACHE[key] = _BucketPrograms(module, opt_name, lr, batch_size)
+        prog = _PROGRAM_CACHE[key] = _BucketPrograms(
+            module, opt_name, lr, batch_size, seq
+        )
     return prog
 
 
@@ -270,17 +382,36 @@ class FleetMemberModel:
     feature_thresholds: Optional[np.ndarray] = None  # max scaled train error
     total_threshold: Optional[float] = None
     scaler_kind: str = "minmax"  # which fit produced ``scaler``
+    model_type: str = "AutoEncoder"  # estimator family (registry namespace)
+    lookback_window: int = 10  # sequence families only
 
     def _module(self):
-        factory = lookup_factory("AutoEncoder", self.kind)
+        factory = lookup_factory(self.model_type, self.kind)
         return factory(self.n_features, **self.factory_kwargs)
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        """Reconstruction in *input* space (scaling applied and inverted)."""
+        """Model output in *input* space (scaling applied and inverted).
+        Sequence members window X first; output row i is the model value
+        for input row i + lookback_window - 1 (+1 for forecast)."""
         from gordo_components_tpu.ops.scaler import scaler_inverse_transform
 
         Xs = scaler_transform(ScalerParams(*self.scaler), jnp.asarray(X, jnp.float32))
-        out = train_core.batched_apply(self._module(), self.params, np.asarray(Xs))
+        Xin = np.asarray(Xs)
+        if self.model_type != "AutoEncoder":
+            offset = _target_offset_for(self.model_type)
+            lb = self.lookback_window
+            if Xin.shape[0] < lb + offset:
+                # same loud contract as SequenceBaseEstimator._window_inputs
+                raise ValueError(
+                    f"Need at least lookback_window+{offset}={lb + offset} "
+                    f"rows, got {Xin.shape[0]}"
+                )
+            from gordo_components_tpu.native import sliding_windows_host
+
+            Xin = sliding_windows_host(Xin, lb)
+            if offset:
+                Xin = Xin[:-offset]
+        out = train_core.batched_apply(self._module(), self.params, Xin)
         return np.asarray(
             scaler_inverse_transform(ScalerParams(*self.scaler), jnp.asarray(out))
         )
@@ -292,13 +423,22 @@ class FleetMemberModel:
         z-score) so artifact metadata round-trips honestly."""
         from sklearn.pipeline import Pipeline
 
-        from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+        from gordo_components_tpu import models as _models
+        from gordo_components_tpu.models import DiffBasedAnomalyDetector
         from gordo_components_tpu.models.transformers import (
             JaxMinMaxScaler,
             JaxStandardScaler,
         )
 
-        est = AutoEncoder(kind=self.kind, **self.factory_kwargs)
+        est_cls = getattr(_models, self.model_type)
+        if self.model_type == "AutoEncoder":
+            est = est_cls(kind=self.kind, **self.factory_kwargs)
+        else:
+            est = est_cls(
+                kind=self.kind,
+                lookback_window=self.lookback_window,
+                **self.factory_kwargs,
+            )
         est.params_ = self.params
         est.n_features_ = self.n_features
         est.history = dict(self.history)
@@ -331,7 +471,7 @@ class FleetTrainer:
     @capture_args
     def __init__(
         self,
-        kind: str = "feedforward_hourglass",
+        kind: Optional[str] = None,  # default resolves per model family
         epochs: int = 10,
         batch_size: int = 100,  # matches BaseEstimator's default
         learning_rate: float = 1e-3,
@@ -348,8 +488,30 @@ class FleetTrainer:
         host_sync_every: int = 1,
         quantize_rows: bool = True,
         input_scaler: str = "minmax",
+        model_type: str = "AutoEncoder",
+        lookback_window: int = 10,
         **factory_kwargs,
     ):
+        # sequence fleets: same many-model engine, windows gathered in-graph
+        # (train_core.make_seq_train_fns) — item i trains window [i, i+L)
+        # against row i+L-1(+1 for forecast), exactly the single-path
+        # semantics of SequenceBaseEstimator._make_xy
+        if model_type not in _MODEL_TYPES:
+            raise ValueError(
+                f"model_type must be one of {sorted(_MODEL_TYPES)}, "
+                f"got {model_type!r}"
+            )
+        self.model_type = model_type
+        self.lookback_window = int(lookback_window)
+        if kind is None:
+            # per-family default, matching each estimator's own default
+            # kind; an EXPLICIT kind always passes through (a wrong-family
+            # kind then fails loudly in lookup_factory, exactly like the
+            # single-build path)
+            kind = (
+                "feedforward_hourglass" if model_type == "AutoEncoder"
+                else "lstm_hourglass"
+            )
         self.kind = kind
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
@@ -410,10 +572,20 @@ class FleetTrainer:
             k: np.asarray(v.values if hasattr(v, "values") else v, dtype=np.float32)
             for k, v in members.items()
         }
+        # items = training units: rows for the dense family, window starts
+        # for sequence families (rows - lookback + 1 - offset)
+        t_offset = _target_offset_for(self.model_type)
+        warmup = 0 if t_offset is None else self.lookback_window - 1 + t_offset
         for name, X in arrays.items():
             if X.ndim != 2 or X.shape[0] < 1:
                 raise ValueError(f"Member {name!r}: need (rows, features), got {X.shape}")
-            n_batches = -(-X.shape[0] // self.batch_size)
+            n_items = X.shape[0] - warmup
+            if n_items < 1:
+                raise ValueError(
+                    f"Member {name!r}: need at least lookback_window"
+                    f"+offset={warmup + 1} rows, got {X.shape[0]}"
+                )
+            n_batches = -(-n_items // self.batch_size)
             if self.quantize_rows:
                 n_batches = quantize_batch_count(n_batches)
             key = (X.shape[1], n_batches * self.batch_size)
@@ -468,7 +640,7 @@ class FleetTrainer:
     def _fit_bucket(
         self,
         n_features: int,
-        padded_rows: int,
+        padded_items: int,
         names: List[str],
         arrays: Dict[str, np.ndarray],
     ) -> Tuple[Dict[str, FleetMemberModel], List[float]]:
@@ -476,6 +648,12 @@ class FleetTrainer:
         M_real = len(names)
         M = pad_count_to_mesh(M_real, mesh)
         bs = self.batch_size
+        # sequence families: an "item" is a window start; the raw row block
+        # carries warmup extra rows beyond the last item
+        t_offset = _target_offset_for(self.model_type)
+        seq = None if t_offset is None else (self.lookback_window, t_offset)
+        warmup = 0 if seq is None else self.lookback_window - 1 + t_offset
+        padded_rows = padded_items + warmup
 
         # ---- stack + pad host-side (the one unavoidable host loop;
         # multithreaded C++ when the native lib is available, with dummies
@@ -490,11 +668,13 @@ class FleetTrainer:
         Xd = jax.device_put(jnp.asarray(Xs), sharding)
         maskd = jax.device_put(jnp.asarray(masks), sharding)
 
-        # ---- per-member train/validation masks over the same padded
-        # buffer: the LAST int(rows*split) real rows of each member are
-        # holdout. Input/error scalers keep the FULL mask (the single-model
+        # ---- per-member train/validation masks in ITEM space (items ==
+        # rows for the dense family, window starts for sequences): the LAST
+        # int(items*split) real items of each member are holdout — exactly
+        # BaseEstimator.fit's split over the (windowed) training units.
+        # Input/error scalers keep the FULL row mask (the single-model
         # pipeline's scaler also fits before the estimator's internal
-        # split). Members whose split floors to 0 val rows monitor train
+        # split). Members whose split floors to 0 val items monitor train
         # loss, like a single build with n_val == 0. ----
         use_val = self.validation_split > 0.0
         # mesh-padding dummy slots replicate real members CYCLICALLY
@@ -503,21 +683,24 @@ class FleetTrainer:
         n_rows = np.array(
             [arrays[names[i % M_real]].shape[0] for i in range(M)]
         )
-        n_val = (n_rows * self.validation_split).astype(np.int64)
-        n_train = n_rows - n_val
+        n_items = n_rows - warmup
+        item_idx = np.arange(padded_items)[None, :]
+        item_mask_np = (item_idx < n_items[:, None]).astype(np.float32)
+        item_maskd = jax.device_put(jnp.asarray(item_mask_np), sharding)
+        n_val = (n_items * self.validation_split).astype(np.int64)
+        n_train = n_items - n_val
         has_val = n_val > 0
         if use_val:
-            row_idx = np.arange(padded_rows)[None, :]
-            train_mask = (row_idx < n_train[:, None]).astype(np.float32)
+            train_mask = (item_idx < n_train[:, None]).astype(np.float32)
             vmask_np = (
-                (row_idx >= n_train[:, None]) & (row_idx < n_rows[:, None])
+                (item_idx >= n_train[:, None]) & (item_idx < n_items[:, None])
             ).astype(np.float32)
             train_maskd = jax.device_put(jnp.asarray(train_mask), sharding)
             val_maskd = jax.device_put(jnp.asarray(vmask_np), sharding)
         else:
-            train_maskd = maskd
+            train_maskd = item_maskd
             val_maskd = jax.device_put(
-                jnp.zeros((M, padded_rows), jnp.float32), sharding
+                jnp.zeros((M, padded_items), jnp.float32), sharding
             )
 
         # ---- per-member scalers, fitted on device (masked rows excluded
@@ -528,19 +711,21 @@ class FleetTrainer:
         Xd = jnp.where(maskd[..., None] > 0, Xd, 0.0)
 
         # ---- build module + stacked train state (programs are cached
-        # process-wide per (module, optimizer, batch size)) ----
-        factory = lookup_factory("AutoEncoder", self.kind)
+        # process-wide per (module, optimizer, batch size, seq)) ----
+        factory = lookup_factory(self.model_type, self.kind)
         module = factory(
             n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
         )
         progs = _bucket_programs(
-            module, self.optimizer, self.learning_rate, min(bs, padded_rows)
+            module, self.optimizer, self.learning_rate,
+            min(bs, padded_items), seq,
         )
         init_stacked = progs.init_stacked
         run_epoch = progs.run_epoch
 
         rngs = jax.random.split(jax.random.PRNGKey(self.seed), M)
-        sample = Xd[:, 0, :]  # (M, n_features)
+        # shape-inference sample: one row (dense) or one window (sequence)
+        sample = Xd[:, 0, :] if seq is None else Xd[:, : self.lookback_window, :]
         states = init_stacked(rngs, sample)
         state_treedef = jax.tree.structure(states)
 
@@ -572,6 +757,8 @@ class FleetTrainer:
 
             key = bucket_checkpoint_key(
                 [
+                    self.model_type,
+                    self.lookback_window,
                     self.kind,
                     sorted(self.factory_kwargs.items()),
                     self.compute_dtype,
@@ -816,9 +1003,10 @@ class FleetTrainer:
 
         # ---- error scalers + thresholds for the anomaly contract: one
         # vmapped pass (parity with DiffBasedAnomalyDetector.fit, which
-        # records max scaled training error as the default threshold) ----
+        # records max scaled training error as the default threshold);
+        # item mask == row mask for the dense family ----
         err_scalers, feat_thresh, total_thresh = progs.fit_error_scalers(
-            final_params, Xd, maskd
+            final_params, Xd, item_maskd
         )
         feat_thresh = np.asarray(feat_thresh)
         total_thresh = np.asarray(total_thresh)
@@ -856,6 +1044,8 @@ class FleetTrainer:
                 feature_thresholds=feat_thresh[i],
                 total_threshold=float(total_thresh[i]),
                 scaler_kind=self.input_scaler,
+                model_type=self.model_type,
+                lookback_window=self.lookback_window,
             )
         # clear only once results are unstacked on host: a preemption during
         # the error-scaler pass / unstacking above can still resume from the

@@ -1,0 +1,206 @@
+"""Sequence-model fleet training: gather-windowed gang programs
+(parallel/fleet.py) must train LSTM autoencoder/forecast members with the
+single-path semantics of SequenceBaseEstimator (windows [i, i+L) against
+row i+L-1+offset), unstack to servable detectors, and route through
+extract_fleetable."""
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.builder.fleet_build import extract_fleetable
+from gordo_components_tpu.parallel import FleetTrainer
+
+LOOKBACK = 8
+
+
+def _seq_members(n, rows=96, f=4, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(rows)
+    out = {}
+    for i in range(n):
+        freqs = 0.05 + 0.01 * rng.rand(f)
+        X = np.sin(np.outer(t, freqs)) + rng.normal(scale=0.03, size=(rows, f))
+        out[f"m{i}"] = X.astype("float32")
+    return out
+
+
+@pytest.fixture(scope="module")
+def lstm_fleet():
+    members = _seq_members(3)
+    trainer = FleetTrainer(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(8,),
+        lookback_window=LOOKBACK, epochs=2, batch_size=32, seed=0,
+    )
+    return trainer.fit(members), members
+
+
+class TestLSTMFleet:
+    def test_members_trained_with_finite_losses(self, lstm_fleet):
+        models, members = lstm_fleet
+        assert set(models) == set(members)
+        for m in models.values():
+            assert len(m.history["loss"]) == 2
+            assert np.isfinite(m.history["loss"]).all()
+            assert m.model_type == "LSTMAutoEncoder"
+            assert m.lookback_window == LOOKBACK
+
+    def test_predict_shape_and_alignment(self, lstm_fleet):
+        models, members = lstm_fleet
+        X = members["m0"]
+        pred = models["m0"].predict(X)
+        # output row i corresponds to input row i + LOOKBACK - 1
+        assert pred.shape == (X.shape[0] - LOOKBACK + 1, X.shape[1])
+
+    def test_training_actually_learns(self, lstm_fleet):
+        models, members = lstm_fleet
+        # periodic signal, 2 epochs: loss must drop from epoch 1 to 2
+        for m in models.values():
+            assert m.history["loss"][1] < m.history["loss"][0] * 1.5
+
+    def test_to_estimator_round_trip(self, lstm_fleet):
+        models, members = lstm_fleet
+        det = models["m0"].to_estimator()
+        from gordo_components_tpu.models import LSTMAutoEncoder
+
+        assert isinstance(det.base_estimator.steps[-1][1], LSTMAutoEncoder)
+        adf = det.anomaly(members["m0"])
+        assert ("total-anomaly-scaled", "") in adf.columns
+        assert np.isfinite(
+            adf["total-anomaly-scaled"].values.astype(float)
+        ).all()
+
+    def test_estimator_prediction_matches_member(self, lstm_fleet):
+        models, members = lstm_fleet
+        det = models["m0"].to_estimator()
+        X = members["m0"]
+        member_pred = models["m0"].predict(X)
+        # pipeline: scaler.transform -> est.predict (scaled space) — compare
+        # member's input-space output against inverse-transformed pipeline
+        pipe = det.base_estimator
+        est_pred = pipe.steps[-1][1].predict(pipe.steps[0][1].transform(X))
+        inv = pipe.steps[0][1].inverse_transform(est_pred)
+        np.testing.assert_allclose(member_pred, inv, rtol=1e-4, atol=1e-5)
+
+
+class TestForecastFleet:
+    def test_forecast_offset_semantics(self):
+        members = _seq_members(2, rows=80)
+        trainer = FleetTrainer(
+            model_type="LSTMForecast", kind="lstm_symmetric", dims=(8,),
+            lookback_window=LOOKBACK, epochs=1, batch_size=32,
+        )
+        models = trainer.fit(members)
+        X = members["m0"]
+        pred = models["m0"].predict(X)
+        # forecast consumes one extra row of warmup: nw - 1 outputs
+        assert pred.shape == (X.shape[0] - LOOKBACK, X.shape[1])
+        for m in models.values():
+            assert np.isfinite(m.history["loss"]).all()
+
+
+class TestSeqBucketing:
+    def test_ragged_members_bucket_and_train(self):
+        rng = np.random.RandomState(1)
+        members = {}
+        for i, rows in enumerate([40, 55, 70, 90, 120, 41, 56, 88]):
+            members[f"r{i}"] = rng.rand(rows, 3).astype("float32")
+        trainer = FleetTrainer(
+            model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(6,),
+            lookback_window=LOOKBACK, epochs=1, batch_size=16,
+        )
+        models = trainer.fit(members)
+        assert set(models) == set(members)
+        # quantized item-count ladder: 8 distinct row counts, few programs
+        assert len(trainer.last_stats["buckets"]) <= 4
+
+    def test_too_short_member_rejected(self):
+        trainer = FleetTrainer(
+            model_type="LSTMAutoEncoder", lookback_window=LOOKBACK, epochs=1
+        )
+        with pytest.raises(ValueError, match="lookback_window"):
+            trainer.fit({"short": np.random.rand(LOOKBACK - 1, 3).astype("f")})
+
+    def test_validation_split_monitors_val_loss(self):
+        members = _seq_members(2, rows=120)
+        trainer = FleetTrainer(
+            model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(8,),
+            lookback_window=LOOKBACK, epochs=2, batch_size=32,
+            validation_split=0.25,
+        )
+        models = trainer.fit(members)
+        for m in models.values():
+            assert "val_loss" in m.history
+            assert np.isfinite(m.history["val_loss"]).all()
+
+
+class TestSeqExtractFleetable:
+    def _config(self, path, est_kwargs):
+        return {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {path: est_kwargs},
+                        ]
+                    }
+                }
+            }
+        }
+
+    def test_lstm_config_fleetable(self):
+        kwargs = extract_fleetable(
+            self._config(
+                "gordo_components_tpu.models.LSTMAutoEncoder",
+                {"lookback_window": 12, "epochs": 2},
+            )
+        )
+        assert kwargs is not None
+        assert kwargs["model_type"] == "LSTMAutoEncoder"
+        assert kwargs["lookback_window"] == 12
+
+    def test_reference_era_lstm_path_fleetable(self):
+        kwargs = extract_fleetable(
+            self._config(
+                "gordo_components.model.models.KerasLSTMAutoEncoder",
+                {"lookback_window": 16},
+            )
+        )
+        assert kwargs is not None and kwargs["model_type"] == "LSTMAutoEncoder"
+
+    def test_forecast_config_fleetable(self):
+        kwargs = extract_fleetable(
+            self._config(
+                "gordo_components_tpu.models.LSTMForecast", {"epochs": 1}
+            )
+        )
+        assert kwargs is not None and kwargs["model_type"] == "LSTMForecast"
+
+    def test_unknown_seq_kwarg_not_fleetable(self):
+        assert (
+            extract_fleetable(
+                self._config(
+                    "gordo_components_tpu.models.LSTMAutoEncoder",
+                    {"bespoke_knob": 1},
+                )
+            )
+            is None
+        )
+
+
+def test_lstm_fleet_members_bank_and_score(lstm_fleet):
+    """The full serving story: sequence fleet members unstack into
+    detectors the HBM bank stacks, with bank scoring matching .anomaly()."""
+    import pandas as pd
+
+    from gordo_components_tpu.server.bank import ModelBank
+
+    models, members = lstm_fleet
+    dets = {n: m.to_estimator() for n, m in models.items()}
+    bank = ModelBank.from_models(dets)
+    cov = bank.coverage()
+    assert cov["banked"] == len(dets) and not cov["fallback"], cov
+    X = members["m1"]
+    expected = dets["m1"].anomaly(X)
+    got = bank.score("m1", X).to_frame()
+    pd.testing.assert_frame_equal(got, expected, rtol=1e-3, atol=1e-4)
