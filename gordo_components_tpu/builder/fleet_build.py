@@ -50,6 +50,10 @@ _SEQ_PATHS = {
         "gordo_components_tpu.models.models.LSTMForecast",
         "gordo_components.model.models.KerasLSTMForecast",
     ),
+    "ConvAutoEncoder": (
+        "gordo_components_tpu.models.ConvAutoEncoder",
+        "gordo_components_tpu.models.models.ConvAutoEncoder",
+    ),
 }
 _DET_PATHS = (
     "gordo_components_tpu.models.DiffBasedAnomalyDetector",
@@ -85,7 +89,7 @@ _FACTORY_KEYS = frozenset(
     {
         "encoding_dim", "decoding_dim", "encoding_func", "decoding_func",
         "out_func", "dims", "funcs", "encoding_layers", "compression_factor",
-        "func",
+        "func", "channels", "kernel_size",
     }
 )
 

@@ -331,7 +331,7 @@ def quantize_batch_count(n: int) -> int:
 
 
 # model families the fleet engine trains
-_MODEL_TYPES = ("AutoEncoder", "LSTMAutoEncoder", "LSTMForecast")
+_MODEL_TYPES = ("AutoEncoder", "LSTMAutoEncoder", "LSTMForecast", "ConvAutoEncoder")
 
 
 def _target_offset_for(model_type: str) -> Optional[int]:
@@ -345,6 +345,19 @@ def _target_offset_for(model_type: str) -> Optional[int]:
     from gordo_components_tpu import models as _models
 
     return int(getattr(_models, model_type)._target_offset)
+
+
+def _family_defaults(model_type: str) -> Tuple[str, int]:
+    """(default kind, default lookback) read from the estimator class's
+    own constructor signature — one source of truth with the single path."""
+    import inspect
+
+    from gordo_components_tpu import models as _models
+
+    sig = inspect.signature(getattr(_models, model_type).__init__)
+    kind = sig.parameters["kind"].default
+    lb_param = sig.parameters.get("lookback_window")
+    return kind, (int(lb_param.default) if lb_param is not None else 1)
 
 _PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
 
@@ -489,7 +502,7 @@ class FleetTrainer:
         quantize_rows: bool = True,
         input_scaler: str = "minmax",
         model_type: str = "AutoEncoder",
-        lookback_window: int = 10,
+        lookback_window: Optional[int] = None,  # default per model family
         **factory_kwargs,
     ):
         # sequence fleets: same many-model engine, windows gathered in-graph
@@ -502,17 +515,15 @@ class FleetTrainer:
                 f"got {model_type!r}"
             )
         self.model_type = model_type
-        self.lookback_window = int(lookback_window)
-        if kind is None:
-            # per-family default, matching each estimator's own default
-            # kind; an EXPLICIT kind always passes through (a wrong-family
-            # kind then fails loudly in lookup_factory, exactly like the
-            # single-build path)
-            kind = (
-                "feedforward_hourglass" if model_type == "AutoEncoder"
-                else "lstm_hourglass"
-            )
-        self.kind = kind
+        default_kind, default_lb = _family_defaults(model_type)
+        self.lookback_window = int(
+            default_lb if lookback_window is None else lookback_window
+        )
+        # per-family defaults come from the estimator class's own ctor
+        # signature; an EXPLICIT kind always passes through (a wrong-family
+        # kind then fails loudly in lookup_factory, exactly like the
+        # single-build path)
+        self.kind = default_kind if kind is None else kind
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
@@ -758,7 +769,10 @@ class FleetTrainer:
             key = bucket_checkpoint_key(
                 [
                     self.model_type,
-                    self.lookback_window,
+                    # lookback only shapes sequence programs; keying it for
+                    # the dense family would invalidate resumable dense
+                    # checkpoints whenever its (unused) default shifts
+                    self.lookback_window if seq is not None else None,
                     self.kind,
                     sorted(self.factory_kwargs.items()),
                     self.compute_dtype,

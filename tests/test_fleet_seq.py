@@ -98,6 +98,50 @@ class TestForecastFleet:
             assert np.isfinite(m.history["loss"]).all()
 
 
+class TestConvFleet:
+    def test_conv_members_train_and_serve(self):
+        members = _seq_members(2, rows=96)
+        # conv family defaults (kind=conv1d_autoencoder, lookback 16) come
+        # from the estimator class signature — no explicit kind needed
+        trainer = FleetTrainer(
+            model_type="ConvAutoEncoder", epochs=2, batch_size=32,
+            channels=(8, 4),
+        )
+        assert trainer.kind == "conv1d_autoencoder"
+        assert trainer.lookback_window == 16
+        models = trainer.fit(members)
+        for m in models.values():
+            assert np.isfinite(m.history["loss"]).all()
+        det = models["m0"].to_estimator()
+        from gordo_components_tpu.models import ConvAutoEncoder
+
+        assert isinstance(det.base_estimator.steps[-1][1], ConvAutoEncoder)
+        adf = det.anomaly(members["m0"])
+        assert np.isfinite(
+            adf["total-anomaly-scaled"].values.astype(float)
+        ).all()
+
+    def test_conv_config_fleetable(self):
+        config = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_components_tpu.models.ConvAutoEncoder": {
+                                    "channels": [8, 4], "epochs": 1,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+        kwargs = extract_fleetable(config)
+        assert kwargs is not None and kwargs["model_type"] == "ConvAutoEncoder"
+
+
 class TestSeqBucketing:
     def test_ragged_members_bucket_and_train(self):
         rng = np.random.RandomState(1)
