@@ -69,16 +69,18 @@ _STANDARD_SCALER_PATHS = (
     "gordo_components_tpu.models.transformers.JaxStandardScaler",
 )
 
-# AutoEncoder kwargs the fleet path honors with semantics identical to the
+# Estimator kwargs the fleet path honors with semantics identical to the
 # single-build path: FleetTrainer's own training knobs (including
-# validation_split, whose val-loss drives the per-member ES mask) plus the
-# feedforward factory surface. Anything else (e.g. loss overrides) must
-# take the single-build path rather than be silently dropped.
+# validation_split, whose val-loss drives the per-member ES mask, and
+# loss/kl_weight, resolved per module exactly like BaseEstimator) plus the
+# factory surfaces. Anything else (e.g. data_parallel) must take the
+# single-build path rather than be silently dropped.
 _TRAINER_KEYS = frozenset(
     {
         "kind", "epochs", "batch_size", "learning_rate", "optimizer",
         "early_stopping_patience", "early_stopping_min_delta",
         "validation_split", "seed", "compute_dtype", "quantize_rows",
+        "loss", "kl_weight",
     }
 )
 # NOTE: "input_scaler" is deliberately NOT in _TRAINER_KEYS: it is injected
@@ -89,7 +91,7 @@ _FACTORY_KEYS = frozenset(
     {
         "encoding_dim", "decoding_dim", "encoding_func", "decoding_func",
         "out_func", "dims", "funcs", "encoding_layers", "compression_factor",
-        "func", "channels", "kernel_size",
+        "func", "channels", "kernel_size", "latent_dim",
     }
 )
 

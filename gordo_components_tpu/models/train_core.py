@@ -254,10 +254,19 @@ def make_seq_train_fns(
     return init_fn, epoch_fn
 
 
-def make_seq_eval_fn(module, batch_size: int, lookback: int, target_offset: int = 0):
+def make_seq_eval_fn(
+    module,
+    batch_size: int,
+    lookback: int,
+    target_offset: int = 0,
+    loss: str = "mse",
+    kl_weight: float = 1.0,
+):
     """``eval_fn(params, X, item_mask) -> mean_loss`` over gathered windows
     (validation loss for sequence fleet members), scan-chunked so HBM never
-    holds more than one batch of materialized windows."""
+    holds more than one batch of materialized windows. Uses the SAME loss
+    family as training (fixed eval rng, like :func:`make_eval_fn`)."""
+    loss_fn = make_loss_fn(module, loss=loss, kl_weight=kl_weight)
     t_off = lookback - 1 + target_offset
 
     def eval_fn(params, X, mask):
@@ -267,14 +276,14 @@ def make_seq_eval_fn(module, batch_size: int, lookback: int, target_offset: int 
         Ms = mask.reshape((n_batches, batch_size))
         rows = X.shape[0]
         win_off = jnp.arange(lookback)
+        rng = jax.random.PRNGKey(0)
 
         def step(_, batch):
             ib, mb = batch
             widx = jnp.clip(ib[:, None] + win_off[None, :], 0, rows - 1)
-            pred = module.apply(params, X[widx])
             yb = X[jnp.clip(ib + t_off, 0, rows - 1)]
-            se = jnp.sum((pred - yb) ** 2, axis=-1) / pred.shape[-1]
-            return None, (jnp.sum(se * mb), jnp.sum(mb))
+            lv = loss_fn(params, rng, X[widx], yb, mb)
+            return None, (lv * jnp.sum(mb), jnp.sum(mb))
 
         _, (sums, counts) = jax.lax.scan(step, None, (idxs, Ms))
         return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1.0)

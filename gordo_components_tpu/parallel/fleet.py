@@ -95,18 +95,22 @@ class _BucketPrograms:
     block on device and masks index ITEMS (window starts), so sequence
     fleets train with O(rows) HBM per member instead of O(rows*lookback)."""
 
-    def __init__(self, module, opt_name: str, lr: float, batch_size: int, seq=None):
+    def __init__(
+        self, module, opt_name: str, lr: float, batch_size: int, seq=None,
+        loss: str = "mse", kl_weight: float = 1.0,
+    ):
         self.module = module
         self.seq = seq
         optimizer = train_core.make_optimizer(opt_name, lr)
         if seq is None:
             init_fn, epoch_fn = train_core.make_train_fns(
-                module, optimizer, batch_size
+                module, optimizer, batch_size, loss=loss, kl_weight=kl_weight
             )
         else:
             lookback, t_offset = seq
             init_fn, epoch_fn = train_core.make_seq_train_fns(
-                module, optimizer, batch_size, lookback, t_offset
+                module, optimizer, batch_size, lookback, t_offset,
+                loss=loss, kl_weight=kl_weight,
             )
         self.init_stacked = jax.jit(jax.vmap(init_fn))
 
@@ -123,17 +127,20 @@ class _BucketPrograms:
         # per-member validation loss: the same global masked mean eval_fn
         # computes batchwise in the single-model path (models/models.py),
         # so fleet val-loss ES has identical semantics to BaseEstimator.fit's
-        from gordo_components_tpu.ops.losses import mse_loss
-
         if seq is None:
+            # same loss family as training (VAE members validate with the
+            # ELBO, like make_eval_fn's fixed-rng pass in the single path)
+            val_loss_fn = train_core.make_loss_fn(
+                module, loss=loss, kl_weight=kl_weight
+            )
 
             def member_val_loss(params, x, vmask):
-                pred = module.apply(params, x)
-                return mse_loss(pred, x, vmask)
+                return val_loss_fn(params, jax.random.PRNGKey(0), x, x, vmask)
 
         else:
             member_val_loss = train_core.make_seq_eval_fn(
-                module, batch_size, seq[0], seq[1]
+                module, batch_size, seq[0], seq[1],
+                loss=loss, kl_weight=kl_weight,
             )
 
         self._vm_eval = jax.vmap(member_val_loss)
@@ -363,18 +370,19 @@ _PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
 
 
 def _bucket_programs(
-    module, opt_name: str, lr: float, batch_size: int, seq=None
+    module, opt_name: str, lr: float, batch_size: int, seq=None,
+    loss: str = "mse", kl_weight: float = 1.0,
 ) -> _BucketPrograms:
-    key = (module, opt_name, float(lr), int(batch_size), seq)
+    key = (module, opt_name, float(lr), int(batch_size), seq, loss, float(kl_weight))
     try:
         prog = _PROGRAM_CACHE.get(key)
     except TypeError:  # unhashable factory kwargs: build uncached
-        return _BucketPrograms(module, opt_name, lr, batch_size, seq)
+        return _BucketPrograms(module, opt_name, lr, batch_size, seq, loss, kl_weight)
     if prog is None:
         if len(_PROGRAM_CACHE) >= 128:  # bound on pathological churn
             _PROGRAM_CACHE.clear()
         prog = _PROGRAM_CACHE[key] = _BucketPrograms(
-            module, opt_name, lr, batch_size, seq
+            module, opt_name, lr, batch_size, seq, loss, kl_weight
         )
     return prog
 
@@ -397,6 +405,8 @@ class FleetMemberModel:
     scaler_kind: str = "minmax"  # which fit produced ``scaler``
     model_type: str = "AutoEncoder"  # estimator family (registry namespace)
     lookback_window: int = 10  # sequence families only
+    loss: str = "auto"  # the CONFIGURED loss (metadata/refit parity)
+    kl_weight: float = 1.0
 
     def _module(self):
         factory = lookup_factory(self.model_type, self.kind)
@@ -444,12 +454,17 @@ class FleetMemberModel:
         )
 
         est_cls = getattr(_models, self.model_type)
+        # the CONFIGURED loss/kl_weight ride along so metadata and any
+        # refit of the loaded artifact match a single build of the same
+        # config (the fleet resolved "auto" the same way fit would)
+        common = dict(loss=self.loss, kl_weight=self.kl_weight)
         if self.model_type == "AutoEncoder":
-            est = est_cls(kind=self.kind, **self.factory_kwargs)
+            est = est_cls(kind=self.kind, **common, **self.factory_kwargs)
         else:
             est = est_cls(
                 kind=self.kind,
                 lookback_window=self.lookback_window,
+                **common,
                 **self.factory_kwargs,
             )
         est.params_ = self.params
@@ -503,6 +518,8 @@ class FleetTrainer:
         input_scaler: str = "minmax",
         model_type: str = "AutoEncoder",
         lookback_window: Optional[int] = None,  # default per model family
+        loss: str = "auto",
+        kl_weight: float = 1.0,
         **factory_kwargs,
     ):
         # sequence fleets: same many-model engine, windows gathered in-graph
@@ -524,6 +541,11 @@ class FleetTrainer:
         # kind then fails loudly in lookup_factory, exactly like the
         # single-build path)
         self.kind = default_kind if kind is None else kind
+        # "auto" resolves per module exactly like BaseEstimator._resolved_loss
+        # (vae for modules exposing elbo_terms) — the fleet must never train
+        # a variational kind with plain MSE
+        self.loss = loss
+        self.kl_weight = float(kl_weight)
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
@@ -727,9 +749,12 @@ class FleetTrainer:
         module = factory(
             n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
         )
+        loss = self.loss
+        if loss == "auto":  # parity with BaseEstimator._resolved_loss
+            loss = "vae" if hasattr(module, "elbo_terms") else "mse"
         progs = _bucket_programs(
             module, self.optimizer, self.learning_rate,
-            min(bs, padded_items), seq,
+            min(bs, padded_items), seq, loss, self.kl_weight,
         )
         init_stacked = progs.init_stacked
         run_epoch = progs.run_epoch
@@ -777,6 +802,8 @@ class FleetTrainer:
                     sorted(self.factory_kwargs.items()),
                     self.compute_dtype,
                     self.input_scaler,
+                    loss,
+                    self.kl_weight,
                     n_features,
                     padded_rows,
                     list(names),
@@ -1060,6 +1087,8 @@ class FleetTrainer:
                 scaler_kind=self.input_scaler,
                 model_type=self.model_type,
                 lookback_window=self.lookback_window,
+                loss=self.loss,
+                kl_weight=self.kl_weight,
             )
         # clear only once results are unstacked on host: a preemption during
         # the error-scaler pass / unstacking above can still resume from the

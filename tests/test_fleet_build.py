@@ -169,9 +169,11 @@ class TestExtractFleetable:
         assert extract_fleetable(cfg) is None
 
     def test_unsupported_ae_kwargs_not_fleetable(self):
-        """AE kwargs the trainer can't honor (loss overrides, DP) must
+        """AE kwargs the trainer can't honor (DP, bespoke knobs) must
         force the single-build path instead of being silently dropped —
-        while honored knobs like validation_split stay fleetable."""
+        while honored knobs like validation_split (and, since the fleet
+        resolves losses like BaseEstimator, loss/kl_weight) stay
+        fleetable."""
 
         def cfg(ae_kwargs):
             return {
@@ -187,8 +189,9 @@ class TestExtractFleetable:
                 }
             }
 
-        for bad in ({"loss": "mse"}, {"data_parallel": True}):
+        for bad in ({"bespoke_knob": 1}, {"data_parallel": True}):
             assert extract_fleetable(cfg(bad)) is None
+        assert extract_fleetable(cfg({"loss": "mse"})) is not None
         # validation_split is honored by FleetTrainer (val-loss ES parity)
         assert extract_fleetable(cfg({"validation_split": 0.2})) == {
             "validation_split": 0.2

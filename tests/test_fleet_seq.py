@@ -142,6 +142,73 @@ class TestConvFleet:
         assert kwargs is not None and kwargs["model_type"] == "ConvAutoEncoder"
 
 
+class TestVariationalFleet:
+    def test_vae_kind_trains_with_elbo(self):
+        """The fleet must resolve loss='auto' to the ELBO for variational
+        kinds like BaseEstimator does — never silently train them with
+        plain MSE."""
+        members = _seq_members(2, rows=96)
+        trainer = FleetTrainer(
+            kind="feedforward_variational", dims=(16,), latent_dim=4,
+            epochs=2, batch_size=32, seed=0,
+        )
+        models = trainer.fit(members)
+        for m in models.values():
+            assert np.isfinite(m.history["loss"]).all()
+        # ELBO = recon + KL: strictly larger than the plain-MSE loss of an
+        # identically-seeded MSE-forced run
+        mse_models = FleetTrainer(
+            kind="feedforward_variational", dims=(16,), latent_dim=4,
+            epochs=2, batch_size=32, seed=0, loss="mse",
+        ).fit(members)
+        for name in models:
+            assert (
+                models[name].history["loss"][0]
+                > mse_models[name].history["loss"][0]
+            )
+        # the configured loss rides into the unstacked estimator so
+        # metadata/refit match a single build of the same config
+        assert mse_models["m0"].to_estimator().base_estimator.steps[-1][1].loss == "mse"
+        assert models["m0"].to_estimator().base_estimator.steps[-1][1].loss == "auto"
+
+    def test_vae_validation_and_estimator(self):
+        members = _seq_members(2, rows=120)
+        trainer = FleetTrainer(
+            kind="feedforward_variational", dims=(16,), latent_dim=4,
+            epochs=2, batch_size=32, validation_split=0.25,
+        )
+        models = trainer.fit(members)
+        for m in models.values():
+            assert np.isfinite(m.history["val_loss"]).all()
+        det = models["m0"].to_estimator()
+        adf = det.anomaly(members["m0"])
+        assert np.isfinite(
+            adf["total-anomaly-scaled"].values.astype(float)
+        ).all()
+
+    def test_vae_config_fleetable(self):
+        config = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_components_tpu.models.AutoEncoder": {
+                                    "kind": "feedforward_variational",
+                                    "latent_dim": 4, "epochs": 1,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+        kwargs = extract_fleetable(config)
+        assert kwargs is not None
+        assert kwargs["kind"] == "feedforward_variational"
+
+
 class TestSeqBucketing:
     def test_ragged_members_bucket_and_train(self):
         rng = np.random.RandomState(1)
