@@ -13,6 +13,19 @@ from gordo_components_tpu.parallel import FleetTrainer
 LOOKBACK = 8
 
 
+def _detector_pipeline(est_path, est_kwargs, scaler="sklearn.preprocessing.MinMaxScaler"):
+    """The canonical fleetable config shape, shared across this module."""
+    return {
+        "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "sklearn.pipeline.Pipeline": {
+                    "steps": [scaler, {est_path: est_kwargs}]
+                }
+            }
+        }
+    }
+
+
 def _seq_members(n, rows=96, f=4, seed=0):
     rng = np.random.RandomState(seed)
     t = np.arange(rows)
@@ -122,22 +135,10 @@ class TestConvFleet:
         ).all()
 
     def test_conv_config_fleetable(self):
-        config = {
-            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
-                "base_estimator": {
-                    "sklearn.pipeline.Pipeline": {
-                        "steps": [
-                            "sklearn.preprocessing.MinMaxScaler",
-                            {
-                                "gordo_components_tpu.models.ConvAutoEncoder": {
-                                    "channels": [8, 4], "epochs": 1,
-                                }
-                            },
-                        ]
-                    }
-                }
-            }
-        }
+        config = _detector_pipeline(
+            "gordo_components_tpu.models.ConvAutoEncoder",
+            {"channels": [8, 4], "epochs": 1},
+        )
         kwargs = extract_fleetable(config)
         assert kwargs is not None and kwargs["model_type"] == "ConvAutoEncoder"
 
@@ -187,23 +188,10 @@ class TestVariationalFleet:
         ).all()
 
     def test_vae_config_fleetable(self):
-        config = {
-            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
-                "base_estimator": {
-                    "sklearn.pipeline.Pipeline": {
-                        "steps": [
-                            "sklearn.preprocessing.MinMaxScaler",
-                            {
-                                "gordo_components_tpu.models.AutoEncoder": {
-                                    "kind": "feedforward_variational",
-                                    "latent_dim": 4, "epochs": 1,
-                                }
-                            },
-                        ]
-                    }
-                }
-            }
-        }
+        config = _detector_pipeline(
+            "gordo_components_tpu.models.AutoEncoder",
+            {"kind": "feedforward_variational", "latent_dim": 4, "epochs": 1},
+        )
         kwargs = extract_fleetable(config)
         assert kwargs is not None
         assert kwargs["kind"] == "feedforward_variational"
@@ -246,18 +234,7 @@ class TestSeqBucketing:
 
 class TestSeqExtractFleetable:
     def _config(self, path, est_kwargs):
-        return {
-            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
-                "base_estimator": {
-                    "sklearn.pipeline.Pipeline": {
-                        "steps": [
-                            "sklearn.preprocessing.MinMaxScaler",
-                            {path: est_kwargs},
-                        ]
-                    }
-                }
-            }
-        }
+        return _detector_pipeline(path, est_kwargs)
 
     def test_lstm_config_fleetable(self):
         kwargs = extract_fleetable(
@@ -297,6 +274,52 @@ class TestSeqExtractFleetable:
             )
             is None
         )
+
+
+def test_mixed_family_fleet_build(tmp_path):
+    """One build_fleet over dense + LSTM + variational machines: each
+    family gang-trains in its own group, artifacts load, and every
+    resulting detector is bankable."""
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.server.bank import ModelBank
+    from gordo_components_tpu.workflow.config import Machine
+
+    pipeline = _detector_pipeline
+    dataset = {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00Z",
+        "train_end_date": "2020-01-02T00:00:00Z",
+        "tag_list": ["x", "y", "z"],
+    }
+    machines = [
+        Machine(name="dense", dataset=dict(dataset), model=pipeline(
+            "gordo_components_tpu.models.AutoEncoder",
+            {"epochs": 2, "batch_size": 32},
+        )),
+        Machine(name="lstm", dataset=dict(dataset), model=pipeline(
+            "gordo_components_tpu.models.LSTMAutoEncoder",
+            {"lookback_window": 8, "epochs": 2, "batch_size": 32,
+             "kind": "lstm_symmetric", "dims": [6]},
+        )),
+        Machine(name="vae", dataset=dict(dataset), model=pipeline(
+            "gordo_components_tpu.models.AutoEncoder",
+            {"kind": "feedforward_variational", "latent_dim": 4,
+             "dims": [16], "epochs": 2, "batch_size": 32},
+        )),
+    ]
+    out = tmp_path / "models"
+    results = build_fleet(machines, str(out))
+    assert set(results) == {"dense", "lstm", "vae"}
+    # the point of the test: every family took the GANG path, not the
+    # bespoke single-build fallback
+    for name, path in results.items():
+        md = serializer.load_metadata(path)
+        assert md["model"]["fleet_trained"], name
+    dets = {n: serializer.load(p) for n, p in results.items()}
+    bank = ModelBank.from_models(dets)
+    cov = bank.coverage()
+    assert cov["banked"] == 3 and not cov["fallback"], cov
 
 
 def test_lstm_fleet_members_bank_and_score(lstm_fleet):
