@@ -449,45 +449,99 @@ def bench_host_pipeline(n_members=32, n_tags=10, days=30):
     }
 
 
-def bench_lstm_fleet(
-    n_models=256, rows=720, n_features=10, lookback=32, epochs=3,
-    batch_size=128,
+_FLEET_FAMILIES = {
+    # arch summary strings double as the recorded config
+    "lstm": (
+        dict(model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(16,)),
+        "lstm_symmetric(16)",
+    ),
+    "conv": (
+        dict(model_type="ConvAutoEncoder", channels=(16, 8)),
+        "conv1d_autoencoder(16,8)",
+    ),
+    "vae": (
+        dict(kind="feedforward_variational", dims=(64,), latent_dim=8),
+        "feedforward_variational(64->8)",
+    ),
+}
+
+
+def _bench_family_fleet(
+    fam, n_models, rows, n_features, lookback, epochs, batch_size,
 ):
-    """Config 2 at fleet scale — many-model LSTM training with
-    gather-windowed gang programs (windows stay views; HBM holds raw rows
-    only). Compare against lstm_models_per_hour_per_chip (the single-build
-    rate) for the sequence-fleet speedup."""
+    """One zoo family at fleet scale (configs 2/4): gang rate AND a
+    single-build rate of the IDENTICAL architecture/rows/epochs measured
+    in the same run, so the reported speedup is like-for-like."""
     import jax
 
     from gordo_components_tpu.parallel import FleetTrainer
 
+    fam_kwargs, arch = _FLEET_FAMILIES[fam]
     members = _synth_fleet(n_models, rows, n_features)
+    n_chips = len(jax.devices())
     config = dict(
-        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(16,),
-        lookback_window=lookback, epochs=epochs, batch_size=batch_size,
-        compute_dtype="bfloat16", host_sync_every=epochs,
+        epochs=epochs, batch_size=batch_size, compute_dtype="bfloat16",
+        host_sync_every=epochs, **fam_kwargs,
     )
+    if fam != "vae":
+        config["lookback_window"] = lookback
     FleetTrainer(**config).fit(members)  # warm the programs
     trainer = FleetTrainer(**config)
     t0 = time.time()
     trainer.fit(members)
     elapsed = time.time() - t0
-    n_chips = len(jax.devices())
+    fleet_rate = n_models / elapsed * 3600 / n_chips
+
+    # single-build baseline: the SAME config trained one member at a time
+    # (reference-style), measured over a few members on warm programs
+    one = dict(list(members.items())[:1])
+    single_cfg = dict(config)
+    single_cfg.pop("host_sync_every")
+    FleetTrainer(host_sync_every=1, **single_cfg).fit(one)  # warm
+    n_probe = min(3, n_models)
+    t0 = time.time()
+    for name in list(members)[:n_probe]:
+        FleetTrainer(host_sync_every=1, **single_cfg).fit({name: members[name]})
+    single_rate = n_probe / (time.time() - t0) * 3600 / n_chips
+
     return {
-        "lstm_fleet_models_per_hour_per_chip": round(
-            n_models / elapsed * 3600 / n_chips, 1
-        ),
-        "lstm_fleet_wall_seconds": round(elapsed, 2),
-        "lstm_fleet_config": (
-            f"{n_models} models x {rows} rows x {n_features} tags, "
-            f"lstm_symmetric(16), lookback {lookback}, {epochs} epochs, bf16"
+        f"{fam}_fleet_models_per_hour_per_chip": round(fleet_rate, 1),
+        f"{fam}_fleet_wall_seconds": round(elapsed, 2),
+        f"{fam}_fleet_vs_single_same_arch": round(fleet_rate / single_rate, 1),
+        f"{fam}_fleet_config": (
+            f"{n_models} models x {rows} rows x {n_features} tags, {arch}, "
+            + (f"lookback {lookback}, " if fam != "vae" else "")
+            + f"{epochs} epochs, bf16"
         ),
     }
+
+
+def bench_lstm_fleet(n_models=256, rows=720, n_features=10, lookback=32,
+                     epochs=3, batch_size=128):
+    return _bench_family_fleet(
+        "lstm", n_models, rows, n_features, lookback, epochs, batch_size
+    )
+
+
+def bench_conv_fleet(n_models=256, rows=720, n_features=10, lookback=32,
+                     epochs=3, batch_size=128):
+    return _bench_family_fleet(
+        "conv", n_models, rows, n_features, lookback, epochs, batch_size
+    )
+
+
+def bench_vae_fleet(n_models=256, rows=720, n_features=10, lookback=32,
+                    epochs=3, batch_size=128):
+    return _bench_family_fleet(
+        "vae", n_models, rows, n_features, lookback, epochs, batch_size
+    )
 
 
 METRICS = (
     ("fleet", bench_fleet),
     ("lstm_fleet", bench_lstm_fleet),
+    ("conv_fleet", bench_conv_fleet),
+    ("vae_fleet", bench_vae_fleet),
     ("sequential", bench_single_sequential),
     ("server_scoring", bench_server_scoring),
     ("bank_serving", bench_bank_serving),
@@ -505,6 +559,8 @@ METRICS = (
 CPU_KWARGS = {
     "fleet": dict(n_models=256, epochs=3),
     "lstm_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
+    "conv_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
+    "vae_fleet": dict(n_models=32, rows=256, epochs=2),
     "sequential": dict(epochs=3, n_probe=2),
     "model_zoo": dict(rows=720, epochs=2),
     "checkpoint": dict(n_models=64, epochs=3),
@@ -723,6 +779,9 @@ def main():
 
     fleet_rate = detail.get("fleet_models_per_hour_per_chip")
     seq_rate = detail.get("sequential_models_per_hour_per_chip")
+    # per-family fleet speedups ride inside each family metric
+    # ({fam}_fleet_vs_single_same_arch): both sides of those ratios run in
+    # the same child on the same platform with identical configs
     # a speedup ratio is only meaningful when both rates came off the same
     # platform — after a partial CPU fallback the mixed ratio would be
     # inflated by orders of magnitude
