@@ -516,25 +516,20 @@ def _bench_family_fleet(
     }
 
 
-def bench_lstm_fleet(n_models=256, rows=720, n_features=10, lookback=32,
-                     epochs=3, batch_size=128):
-    return _bench_family_fleet(
-        "lstm", n_models, rows, n_features, lookback, epochs, batch_size
-    )
+def _family_fleet_metric(fam):
+    def run(n_models=256, rows=720, n_features=10, lookback=32, epochs=3,
+            batch_size=128):
+        return _bench_family_fleet(
+            fam, n_models, rows, n_features, lookback, epochs, batch_size
+        )
+
+    run.__name__ = f"bench_{fam}_fleet"
+    return run
 
 
-def bench_conv_fleet(n_models=256, rows=720, n_features=10, lookback=32,
-                     epochs=3, batch_size=128):
-    return _bench_family_fleet(
-        "conv", n_models, rows, n_features, lookback, epochs, batch_size
-    )
-
-
-def bench_vae_fleet(n_models=256, rows=720, n_features=10, lookback=32,
-                    epochs=3, batch_size=128):
-    return _bench_family_fleet(
-        "vae", n_models, rows, n_features, lookback, epochs, batch_size
-    )
+bench_lstm_fleet = _family_fleet_metric("lstm")
+bench_conv_fleet = _family_fleet_metric("conv")
+bench_vae_fleet = _family_fleet_metric("vae")
 
 
 METRICS = (
@@ -564,6 +559,8 @@ CPU_KWARGS = {
     "sequential": dict(epochs=3, n_probe=2),
     "model_zoo": dict(rows=720, epochs=2),
     "checkpoint": dict(n_models=64, epochs=3),
+    "bank_serving": dict(n_models=16, iters=5),
+    "bank_sequence": dict(n_models=8, iters=5),
 }
 
 # A metric that produces no result for this long is declared wedged: the

@@ -80,24 +80,68 @@ class WatchmanState:
             timeout = aiohttp.ClientTimeout(total=30)
             sem = asyncio.Semaphore(self.parallelism)
             async with aiohttp.ClientSession(timeout=timeout) as session:
+                # /models carries both the target list and the HBM bank
+                # coverage (which models score from the stacked bank vs
+                # the per-model fallback, and why) — fetched even with an
+                # explicit target list so operators see serving coverage
+                # fleet-wide, but then CONCURRENTLY with the health poll
+                # so a hung collection endpoint can't stall the refresh
+
+                async def fetch_models():
+                    async with session.get(
+                        f"{self.base_url}/gordo/v0/{self.project}/models"
+                    ) as resp:
+                        return await resp.json()
+
+                bank = None
                 targets = self.targets
                 if targets is None:
                     try:
-                        async with session.get(
-                            f"{self.base_url}/gordo/v0/{self.project}/models"
-                        ) as resp:
-                            targets = (await resp.json())["models"]
+                        body = await fetch_models()
+                        bank = body.get("bank")
+                        targets = body["models"]
                     except Exception as exc:
                         logger.warning("target discovery failed: %s", exc)
                         targets = []
-                endpoints = await asyncio.gather(
-                    *(self._check_target(session, sem, t) for t in targets)
-                )
+                    results = await asyncio.gather(
+                        *(self._check_target(session, sem, t) for t in targets)
+                    )
+                else:
+                    results, models_body = await asyncio.gather(
+                        asyncio.gather(
+                            *(self._check_target(session, sem, t) for t in targets)
+                        ),
+                        fetch_models(),
+                        return_exceptions=True,
+                    )
+                    if isinstance(results, BaseException):
+                        raise results
+                    if isinstance(models_body, BaseException):
+                        # coverage-only fetch: targets are intact, so this
+                        # is diagnostic noise, not a discovery failure
+                        logger.debug("bank coverage fetch failed: %s", models_body)
+                    else:
+                        bank = models_body.get("bank")
+            endpoints = list(results)
+            if bank is not None:
+                banked = set(bank.get("banked", []))
+                fallback = bank.get("fallback", {})
+                for entry in endpoints:
+                    t = entry["target"]
+                    if t in banked:
+                        entry["banked"] = True
+                    elif t in fallback:
+                        entry["banked"] = False
+                        entry["bank-fallback-reason"] = fallback[t]
+                    else:
+                        entry["banked"] = None  # not known to the collection
             self._cache = {
                 "project_name": self.project,
                 "gordo-watchman-version": __version__,
-                "endpoints": list(endpoints),
+                "endpoints": endpoints,
             }
+            if bank is not None:
+                self._cache["bank"] = bank
             if self.gang_state_dir:
                 from gordo_components_tpu.workflow.gang_state import read_gang_states
 

@@ -43,8 +43,25 @@ async def test_watchman_aggregates_health_and_metadata(collection_dir, live_serv
         assert entry["endpoint"] == f"/gordo/v0/proj/{name}/"
 
 
+async def test_watchman_aggregates_bank_coverage(collection_dir, live_server):
+    """Fleet-wide serving coverage in one place: the snapshot carries the
+    collection's bank summary and per-endpoint banked/fallback flags."""
+    async with live_server(collection_dir) as base_url:
+        body = await WatchmanState("proj", base_url).snapshot()
+    assert "bank" in body
+    bank = body["bank"]
+    assert set(bank["banked"]) | set(bank["fallback"]) == {"m-1", "m-2"}
+    for entry in body["endpoints"]:
+        if entry["target"] in bank["fallback"]:
+            assert entry["banked"] is False
+            assert entry["bank-fallback-reason"]
+        else:
+            assert entry["banked"] is True
+
+
 async def test_watchman_marks_unreachable_unhealthy():
-    # nothing listens on this port; explicit target list skips discovery
+    # nothing listens on this port; targets are explicit (the coverage-only
+    # /models fetch fails quietly alongside the health polls)
     state = WatchmanState(
         "proj", "http://127.0.0.1:1", targets=["m-1"], refresh_interval=30
     )
