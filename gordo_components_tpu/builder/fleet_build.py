@@ -99,16 +99,18 @@ _FACTORY_KEYS = frozenset(
 def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """If ``model_config`` is EXACTLY the canonical anomaly pipeline —
     ``DiffBasedAnomalyDetector(base_estimator=Pipeline(scaler,
-    AutoEncoder))`` with no other detector kwargs and a default-kwargs
-    MinMax/Standard scaler step — return the AutoEncoder kwargs for
-    FleetTrainer (plus ``input_scaler="standard"`` for the z-score
-    variant); else None (single-build path).
+    estimator))`` with a default-kwargs MinMax/Standard scaler step — return
+    the estimator kwargs for FleetTrainer, augmented with the honored
+    routing kwargs (``input_scaler`` for the z-score scaler, ``model_type``
+    for sequence families, ``threshold_quantile``/``require_thresholds``
+    detector knobs the fleet computes identically); else None (single-build
+    path).
 
     The check is deliberately strict: the fleet engine fits exactly the
-    default min-max or z-score affine and builds a default detector, so
-    any config that deviates (extra detector kwargs, scaler kwargs, no
-    scaler step, bare base estimator) must take the single-build path to
-    keep identical semantics.
+    default min-max or z-score affine, so any config that deviates (unknown
+    detector or estimator kwargs, scaler kwargs, no scaler step, bare base
+    estimator, sequence family with a non-default quantile) must take the
+    single-build path to keep identical semantics.
     """
     if not isinstance(model_config, dict) or len(model_config) != 1:
         return None
@@ -116,8 +118,9 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     kwargs = kwargs or {}
     if path not in _DET_PATHS:
         return None
-    if set(kwargs) - {"base_estimator"}:
-        return None  # e.g. threshold_quantile/require_thresholds overrides
+    det_kwargs = {k: v for k, v in kwargs.items() if k != "base_estimator"}
+    if set(det_kwargs) - {"threshold_quantile", "require_thresholds"}:
+        return None  # detector overrides the fleet can't honor
     base = kwargs.get("base_estimator")
     if not (isinstance(base, dict) and len(base) == 1):
         return None
@@ -149,6 +152,12 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             ae = dict(ae, input_scaler=scaler_kind)
         if model_type != "AutoEncoder":
             ae = dict(ae, model_type=model_type)
+            if float(det_kwargs.get("threshold_quantile", 1.0)) != 1.0:
+                # sequence error thresholds stream; exact quantiles need
+                # the single-build path
+                return None
+        if det_kwargs:
+            ae = dict(ae, **det_kwargs)
         return ae
     return None
 

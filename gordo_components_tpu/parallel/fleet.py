@@ -98,6 +98,7 @@ class _BucketPrograms:
     def __init__(
         self, module, opt_name: str, lr: float, batch_size: int, seq=None,
         loss: str = "mse", kl_weight: float = 1.0,
+        threshold_quantile: float = 1.0,
     ):
         self.module = module
         self.seq = seq
@@ -146,14 +147,14 @@ class _BucketPrograms:
         self._vm_eval = jax.vmap(member_val_loss)
         self.eval_stacked = jax.jit(self._vm_eval)
         self.fit_error_scalers = (
-            self._make_error_scalers(module)
+            self._make_error_scalers(module, threshold_quantile)
             if seq is None
             else self._make_seq_error_scalers(module, batch_size, *seq)
         )
         self._chunks: Dict[Tuple, Any] = {}
 
     @staticmethod
-    def _make_error_scalers(module):
+    def _make_error_scalers(module, q: float = 1.0):
         @jax.jit
         def fit_error_scalers(params, X, mask):
             def one(p, x, m):
@@ -162,10 +163,17 @@ class _BucketPrograms:
                 diff = jnp.where(m[..., None] > 0, diff, jnp.nan)
                 es = fit_minmax(diff)
                 scaled = scaler_transform(es, diff)
-                feat_thresh = jnp.nanmax(scaled, axis=0)
                 total = jnp.sqrt(jnp.nansum(scaled**2, axis=-1))
                 total = jnp.where(m > 0, total, jnp.nan)
-                return es, feat_thresh, jnp.nanmax(total)
+                if q >= 1.0:
+                    return es, jnp.nanmax(scaled, axis=0), jnp.nanmax(total)
+                # detector parity: quantile of training scaled errors
+                # (np.quantile linear interpolation == jnp.nanquantile's)
+                return (
+                    es,
+                    jnp.nanquantile(scaled, q, axis=0),
+                    jnp.nanquantile(total, q),
+                )
 
             return jax.vmap(one)(params, X, mask)
 
@@ -371,18 +379,25 @@ _PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
 
 def _bucket_programs(
     module, opt_name: str, lr: float, batch_size: int, seq=None,
-    loss: str = "mse", kl_weight: float = 1.0,
+    loss: str = "mse", kl_weight: float = 1.0, threshold_quantile: float = 1.0,
 ) -> _BucketPrograms:
-    key = (module, opt_name, float(lr), int(batch_size), seq, loss, float(kl_weight))
+    key = (
+        module, opt_name, float(lr), int(batch_size), seq, loss,
+        float(kl_weight), float(threshold_quantile),
+    )
     try:
         prog = _PROGRAM_CACHE.get(key)
     except TypeError:  # unhashable factory kwargs: build uncached
-        return _BucketPrograms(module, opt_name, lr, batch_size, seq, loss, kl_weight)
+        return _BucketPrograms(
+            module, opt_name, lr, batch_size, seq, loss, kl_weight,
+            threshold_quantile,
+        )
     if prog is None:
         if len(_PROGRAM_CACHE) >= 128:  # bound on pathological churn
             _PROGRAM_CACHE.clear()
         prog = _PROGRAM_CACHE[key] = _BucketPrograms(
-            module, opt_name, lr, batch_size, seq, loss, kl_weight
+            module, opt_name, lr, batch_size, seq, loss, kl_weight,
+            threshold_quantile,
         )
     return prog
 
@@ -407,6 +422,8 @@ class FleetMemberModel:
     lookback_window: int = 10  # sequence families only
     loss: str = "auto"  # the CONFIGURED loss (metadata/refit parity)
     kl_weight: float = 1.0
+    threshold_quantile: float = 1.0
+    require_thresholds: bool = False
 
     def _module(self):
         factory = lookup_factory(self.model_type, self.kind)
@@ -478,7 +495,11 @@ class FleetMemberModel:
         scaler.set_fitted(ScalerParams(*self.scaler), self.n_features)
 
         pipe = Pipeline([("scale", scaler), ("model", est)])
-        det = DiffBasedAnomalyDetector(base_estimator=pipe)
+        det = DiffBasedAnomalyDetector(
+            base_estimator=pipe,
+            threshold_quantile=self.threshold_quantile,
+            require_thresholds=self.require_thresholds,
+        )
         det.error_scaler_ = ScalerParams(*jax.tree.map(np.asarray, self.error_scaler))
         det.tags_ = list(self.tags) if self.tags else [
             f"feature-{i}" for i in range(self.n_features)
@@ -520,6 +541,8 @@ class FleetTrainer:
         lookback_window: Optional[int] = None,  # default per model family
         loss: str = "auto",
         kl_weight: float = 1.0,
+        threshold_quantile: float = 1.0,
+        require_thresholds: bool = False,
         **factory_kwargs,
     ):
         # sequence fleets: same many-model engine, windows gathered in-graph
@@ -546,6 +569,23 @@ class FleetTrainer:
         # a variational kind with plain MSE
         self.loss = loss
         self.kl_weight = float(kl_weight)
+        # detector knobs, honored so quantile-threshold configs keep fleet
+        # speed; the sequence error pass streams (no exact quantiles), so
+        # non-default quantiles are dense-family only
+        self.threshold_quantile = float(threshold_quantile)
+        if not 0.0 <= self.threshold_quantile <= 1.0:
+            # fail fast with the same contract np.quantile enforces in the
+            # single-build detector — never after a full gang training run
+            raise ValueError(
+                f"threshold_quantile must be in [0, 1], got {threshold_quantile}"
+            )
+        self.require_thresholds = bool(require_thresholds)
+        if self.threshold_quantile != 1.0 and model_type != "AutoEncoder":
+            raise ValueError(
+                "threshold_quantile != 1.0 requires the dense family "
+                "(sequence error thresholds stream over window chunks); "
+                "use the single-build path"
+            )
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
@@ -755,6 +795,7 @@ class FleetTrainer:
         progs = _bucket_programs(
             module, self.optimizer, self.learning_rate,
             min(bs, padded_items), seq, loss, self.kl_weight,
+            self.threshold_quantile,
         )
         init_stacked = progs.init_stacked
         run_epoch = progs.run_epoch
@@ -1089,6 +1130,8 @@ class FleetTrainer:
                 lookback_window=self.lookback_window,
                 loss=self.loss,
                 kl_weight=self.kl_weight,
+                threshold_quantile=self.threshold_quantile,
+                require_thresholds=self.require_thresholds,
             )
         # clear only once results are unstacked on host: a preemption during
         # the error-scaler pass / unstacking above can still resume from the

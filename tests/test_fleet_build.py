@@ -135,17 +135,23 @@ class TestExtractFleetable:
         assert extract_fleetable(old) == {"kind": "feedforward_hourglass"}
 
     def test_detector_overrides_not_fleetable(self):
-        """Extra detector kwargs must force the single-build path (the fleet
-        engine builds a default detector)."""
-        cfg = {
-            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
-                "base_estimator": FLEETABLE[
-                    "gordo_components_tpu.models.DiffBasedAnomalyDetector"
-                ]["base_estimator"],
-                "threshold_quantile": 0.99,
+        """Unknown detector kwargs must force the single-build path; the
+        honored detector knobs (threshold_quantile/require_thresholds,
+        which the fleet now computes identically) stay fleetable."""
+
+        def cfg(**det_kwargs):
+            return {
+                "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                    "base_estimator": FLEETABLE[
+                        "gordo_components_tpu.models.DiffBasedAnomalyDetector"
+                    ]["base_estimator"],
+                    **det_kwargs,
+                }
             }
-        }
-        assert extract_fleetable(cfg) is None
+
+        assert extract_fleetable(cfg(bespoke_detector_knob=1)) is None
+        out = extract_fleetable(cfg(threshold_quantile=0.99))
+        assert out is not None and out["threshold_quantile"] == 0.99
 
     def test_scaler_kwargs_not_fleetable(self):
         """A scaler with non-default kwargs (custom feature_range) must not

@@ -330,6 +330,82 @@ class TestSeqExtractFleetable:
         )
 
 
+class TestThresholdQuantile:
+    def test_dense_quantile_thresholds_match_recompute(self):
+        """Fleet quantile thresholds must equal np.quantile over the
+        member's own scaled training errors (detector semantics)."""
+        from gordo_components_tpu.ops.scaler import ScalerParams, scaler_transform
+        import jax.numpy as jnp
+
+        members = _seq_members(2, rows=96)
+        q = 0.9
+        models = FleetTrainer(
+            epochs=2, batch_size=32, threshold_quantile=q, seed=0
+        ).fit(members)
+        for name, m in models.items():
+            X = members[name]
+            Xs = np.asarray(
+                scaler_transform(ScalerParams(*m.scaler), jnp.asarray(X))
+            )
+            from gordo_components_tpu.models import train_core
+
+            pred = train_core.batched_apply(m._module(), m.params, Xs)
+            diff = np.abs(Xs - pred)
+            scaled = np.asarray(
+                scaler_transform(ScalerParams(*m.error_scaler), jnp.asarray(diff))
+            )
+            np.testing.assert_allclose(
+                m.feature_thresholds, np.quantile(scaled, q, axis=0),
+                rtol=1e-4, atol=1e-5,
+            )
+            np.testing.assert_allclose(
+                m.total_threshold,
+                np.quantile(np.linalg.norm(scaled, axis=-1), q),
+                rtol=1e-4, atol=1e-5,
+            )
+            det = m.to_estimator()
+            assert det.threshold_quantile == q
+
+    def test_sequence_quantile_rejected(self):
+        with pytest.raises(ValueError, match="dense family"):
+            FleetTrainer(
+                model_type="LSTMAutoEncoder", threshold_quantile=0.9
+            )
+
+    def test_out_of_range_quantile_rejected_up_front(self):
+        # must fail BEFORE any gang training, like np.quantile would in
+        # the single-build detector
+        for bad in (1.5, -0.1):
+            with pytest.raises(ValueError, match=r"\[0, 1\]"):
+                FleetTrainer(threshold_quantile=bad)
+
+    def test_extraction_routing(self):
+        def cfg(detector_kwargs, est_path="gordo_components_tpu.models.AutoEncoder",
+                est_kwargs=None):
+            c = _detector_pipeline(est_path, est_kwargs or {"epochs": 1})
+            (path, kw), = c.items()
+            kw.update(detector_kwargs)
+            return c
+
+        out = extract_fleetable(cfg({"threshold_quantile": 0.95}))
+        assert out is not None and out["threshold_quantile"] == 0.95
+        out = extract_fleetable(cfg({"require_thresholds": True}))
+        assert out is not None and out["require_thresholds"] is True
+        # sequence + non-default quantile: single path
+        assert (
+            extract_fleetable(
+                cfg(
+                    {"threshold_quantile": 0.95},
+                    est_path="gordo_components_tpu.models.LSTMAutoEncoder",
+                    est_kwargs={"lookback_window": 8},
+                )
+            )
+            is None
+        )
+        # unknown detector kwarg still rejected
+        assert extract_fleetable(cfg({"bespoke": 1})) is None
+
+
 def test_mixed_family_fleet_build(tmp_path):
     """One build_fleet over dense + LSTM + variational machines: each
     family gang-trains in its own group, artifacts load, and every
