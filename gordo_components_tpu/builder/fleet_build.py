@@ -288,6 +288,14 @@ def build_fleet(
     try:
         for machine in machines:
             ae_kwargs = extract_fleetable(machine.model)
+            # the fleet engine trains X -> X (reconstruction); a dataset
+            # declaring target tags supervises X -> y, so it must take the
+            # single-build path (which honors y) rather than silently
+            # training the wrong objective
+            if ae_kwargs is not None and (machine.dataset or {}).get(
+                "target_tag_list"
+            ):
+                ae_kwargs = None
             if ae_kwargs is None:
                 logger.info(
                     "Machine %s: bespoke config, single-build path", machine.name

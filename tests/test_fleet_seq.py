@@ -406,6 +406,41 @@ class TestThresholdQuantile:
         assert extract_fleetable(cfg({"bespoke": 1})) is None
 
 
+def test_target_tag_machines_take_single_build_path(tmp_path):
+    """The fleet engine trains X->X; a dataset with target_tag_list
+    supervises X->y and must NOT be silently reconstruction-trained."""
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.workflow.config import Machine
+
+    dataset = {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00Z",
+        "train_end_date": "2020-01-02T00:00:00Z",
+        "tag_list": ["x1", "x2", "x3"],
+    }
+    machines = [
+        Machine(name="plain", dataset=dict(dataset), model=_detector_pipeline(
+            "gordo_components_tpu.models.AutoEncoder", {"epochs": 1, "batch_size": 64}
+        )),
+        Machine(
+            name="supervised",
+            # same width (detector requires y-width == model output), but
+            # the declared supervision still must route off the fleet
+            dataset=dict(dataset, target_tag_list=["x3", "x2", "x1"]),
+            model=_detector_pipeline(
+                "gordo_components_tpu.models.AutoEncoder",
+                {"epochs": 1, "batch_size": 64},
+            ),
+        ),
+    ]
+    results = build_fleet(machines, str(tmp_path / "m"))
+    md_plain = serializer.load_metadata(results["plain"])
+    md_sup = serializer.load_metadata(results["supervised"])
+    assert md_plain["model"].get("fleet_trained")
+    assert not md_sup["model"].get("fleet_trained")
+
+
 def test_mixed_family_fleet_build(tmp_path):
     """One build_fleet over dense + LSTM + variational machines: each
     family gang-trains in its own group, artifacts load, and every
