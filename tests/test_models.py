@@ -172,6 +172,25 @@ class TestDataParallel:
         ):
             np.testing.assert_allclose(lp, ld, atol=2e-3)
 
+    def test_dp_sequence_estimator_matches_single_device(self):
+        """The long-sequence scaling story (SURVEY.md §5): shard the WINDOW
+        batch over the data mesh — sequence estimators must train under DP
+        with single-device semantics (the shard_map VMA analysis previously
+        rejected flax RNN carries; numerics were always exact)."""
+        from gordo_components_tpu.models import LSTMAutoEncoder
+
+        rng = np.random.RandomState(3)
+        X = rng.rand(600, 4).astype("float32")
+        kwargs = dict(
+            kind="lstm_symmetric", dims=(8,), lookback_window=16,
+            epochs=2, batch_size=64, seed=0,
+        )
+        plain = LSTMAutoEncoder(**kwargs).fit(X)
+        dp = LSTMAutoEncoder(data_parallel=True, **kwargs).fit(X)
+        np.testing.assert_allclose(
+            plain.history["loss"], dp.history["loss"], rtol=1e-4
+        )
+
     def test_dp_with_validation_and_early_stopping(self):
         from gordo_components_tpu.models import AutoEncoder
 
