@@ -187,6 +187,42 @@ def test_parity_all_out_of_window():
     assert isinstance(fast_df.index, pd.DatetimeIndex) and fast_df.empty
 
 
+def test_parity_fuzz_sweep():
+    """Seeded randomized sweep: many (series-count, length, resolution,
+    dtype, gap-profile) combinations must all match the pandas path
+    exactly — the deterministic cousins above each pin one shape, this
+    guards the cross-product."""
+    rng = np.random.RandomState(99)
+    resolutions = ["1min", "5min", "10min", "30min", "1h", "3h", "1d"]
+    for trial in range(25):
+        n_series = int(rng.randint(1, 5))
+        series = []
+        for s in range(n_series):
+            n = int(rng.randint(5, 800))
+            start = pd.Timestamp("2020-01-01", tz="UTC") + pd.Timedelta(
+                minutes=int(rng.randint(0, 20000))
+            )
+            steps = rng.randint(30, 3000, size=n).astype("int64")
+            ts = start.value + np.cumsum(steps) * 1_000_000_000
+            dtype = "float32" if rng.rand() < 0.3 else "float64"
+            vals = rng.randn(n).astype(dtype)
+            if rng.rand() < 0.3:
+                vals[rng.rand(n) < 0.1] = np.nan
+            series.append(
+                pd.Series(
+                    vals, index=pd.DatetimeIndex(ts, tz="UTC"), name=f"t{s}"
+                )
+            )
+        res = resolutions[int(rng.randint(len(resolutions)))]
+        fast_df, fast_meta = join_timeseries(series, START, END, res, fast=True)
+        ref_df, ref_meta = join_timeseries(series, START, END, res, fast=False)
+        pd.testing.assert_frame_equal(
+            fast_df, ref_df, check_freq=False,
+            obj=f"trial {trial} ({n_series} series, {res})",
+        )
+        assert fast_meta == ref_meta, f"trial {trial}"
+
+
 def test_fast_path_is_used_and_not_slower():
     import time
 
