@@ -173,6 +173,21 @@ def make_train_fns(
     return init_fn, epoch_fn
 
 
+def gather_window_batch(X, item_idx, lookback: int, target_offset: int):
+    """(xb, yb) for a batch of window-start items over raw rows ``X``:
+    ``xb`` gathers rows ``[i, i+lookback)``, ``yb`` the target row
+    ``i + lookback - 1 + target_offset``. Indices CLIP into range, so
+    out-of-range (padded) items gather garbage the caller's item mask must
+    zero out — the one shared definition of the windows-as-views index
+    arithmetic (train, eval, and fleet error-scaler programs all use it)."""
+    rows = X.shape[0]
+    widx = jnp.clip(
+        item_idx[:, None] + jnp.arange(lookback)[None, :], 0, rows - 1
+    )
+    yb = X[jnp.clip(item_idx + lookback - 1 + target_offset, 0, rows - 1)]
+    return X[widx], yb
+
+
 def make_seq_train_fns(
     module,
     optimizer: optax.GradientTransformation,
@@ -203,7 +218,6 @@ def make_seq_train_fns(
       validity mask, items_pad a multiple of ``batch_size``.
     """
     loss_fn = make_loss_fn(module, loss=loss, kl_weight=kl_weight)
-    t_off = lookback - 1 + target_offset
 
     def init_fn(rng: jax.Array, sample_w: jnp.ndarray) -> TrainState:
         init_rng, state_rng = jax.random.split(rng)
@@ -223,16 +237,12 @@ def make_seq_train_fns(
         perm = jnp.argsort(jnp.where(mask > 0, keys, 2.0))
         idxs = perm.reshape((n_batches, batch_size))
         Ms = mask[perm].reshape((n_batches, batch_size))
-        rows = X.shape[0]
-        win_off = jnp.arange(lookback)
 
         def step(carry, batch):
             params, opt_state = carry
             ib, mb, brng = batch
             # padded items gather clipped garbage; their mask zeroes them out
-            widx = jnp.clip(ib[:, None] + win_off[None, :], 0, rows - 1)
-            xb = X[widx]  # (batch, lookback, f)
-            yb = X[jnp.clip(ib + t_off, 0, rows - 1)]
+            xb, yb = gather_window_batch(X, ib, lookback, target_offset)
             loss_val, grads = jax.value_and_grad(loss_fn)(params, brng, xb, yb, mb)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
@@ -267,22 +277,18 @@ def make_seq_eval_fn(
     holds more than one batch of materialized windows. Uses the SAME loss
     family as training (fixed eval rng, like :func:`make_eval_fn`)."""
     loss_fn = make_loss_fn(module, loss=loss, kl_weight=kl_weight)
-    t_off = lookback - 1 + target_offset
 
     def eval_fn(params, X, mask):
         n_pad = mask.shape[0]
         n_batches = n_pad // batch_size
         idxs = jnp.arange(n_pad).reshape((n_batches, batch_size))
         Ms = mask.reshape((n_batches, batch_size))
-        rows = X.shape[0]
-        win_off = jnp.arange(lookback)
         rng = jax.random.PRNGKey(0)
 
         def step(_, batch):
             ib, mb = batch
-            widx = jnp.clip(ib[:, None] + win_off[None, :], 0, rows - 1)
-            yb = X[jnp.clip(ib + t_off, 0, rows - 1)]
-            lv = loss_fn(params, rng, X[widx], yb, mb)
+            xb, yb = gather_window_batch(X, ib, lookback, target_offset)
+            lv = loss_fn(params, rng, xb, yb, mb)
             return None, (lv * jnp.sum(mb), jnp.sum(mb))
 
         _, (sums, counts) = jax.lax.scan(step, None, (idxs, Ms))

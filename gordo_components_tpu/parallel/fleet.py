@@ -185,8 +185,6 @@ class _BucketPrograms:
         windows are never materialized beyond one batch — the same anomaly
         contract as the dense path: es = minmax over training |err|,
         feature thresholds = max scaled |err|, total = max scaled norm."""
-        toff = lookback - 1 + t_offset
-
         @jax.jit
         def fit_error_scalers(params, X, mask):
             def one(p, x, m):
@@ -194,14 +192,12 @@ class _BucketPrograms:
                 nb = n_pad // batch_size
                 idxs = jnp.arange(n_pad).reshape((nb, batch_size))
                 Ms = m.reshape((nb, batch_size))
-                rows = x.shape[0]
-                woff = jnp.arange(lookback)
 
                 def diff_batch(ib, mb):
-                    widx = jnp.clip(ib[:, None] + woff[None, :], 0, rows - 1)
-                    pred = module.apply(p, x[widx])
-                    yb = x[jnp.clip(ib + toff, 0, rows - 1)]
-                    d = jnp.abs(yb - pred)
+                    xb, yb = train_core.gather_window_batch(
+                        x, ib, lookback, t_offset
+                    )
+                    d = jnp.abs(yb - module.apply(p, xb))
                     return jnp.where(mb[..., None] > 0, d, jnp.nan)
 
                 def pass1(carry, batch):
