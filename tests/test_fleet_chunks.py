@@ -43,6 +43,48 @@ def test_chunked_matches_per_epoch(sync):
     _assert_same_models(ref, got)
 
 
+@pytest.mark.parametrize("sync", [2, 4])
+def test_chunked_sequence_fleet_matches_per_epoch(sync):
+    """The on-device chunk engine must be family-agnostic: gather-windowed
+    LSTM fleets trained in K-epoch chunks produce the same models as the
+    per-epoch host loop."""
+    members = _members(n=3, rows=90)
+    common = dict(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(6,),
+        lookback_window=8, epochs=4, batch_size=32, seed=3,
+    )
+    ref = FleetTrainer(**common).fit(members)
+    got = FleetTrainer(**common, host_sync_every=sync).fit(members)
+    _assert_same_models(ref, got, rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_seq_validation_early_stopping():
+    """Val-driven early stopping must FIRE for a sequence member whose val
+    windows diverge from training, and the chunked engine must reach the
+    same models as the per-epoch loop."""
+    rng = np.random.RandomState(4)
+    rows = 120
+    t = np.arange(rows)
+    X = (np.sin(0.2 * t)[:, None] * np.ones((1, 3))).astype("float32")
+    X[90:] = 5.0 * rng.randn(30, 3).astype("float32")  # diverging val region
+    members = {"diverge": X, "clean": _members(n=1, rows=rows)["m-0"]}
+    common = dict(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(6,),
+        lookback_window=8, epochs=40, batch_size=32, seed=4,
+        validation_split=0.25, early_stopping_patience=2,
+    )
+    ref = FleetTrainer(**common).fit(members)
+    got = FleetTrainer(**common, host_sync_every=4).fit(members)
+    # the ES path genuinely fired (not a vacuous full-length run)
+    assert len(ref["diverge"].history["loss"]) < 40
+    _assert_same_models(ref, got, rtol=1e-3, atol=1e-4)
+    for name in ref:
+        np.testing.assert_allclose(
+            ref[name].history["val_loss"], got[name].history["val_loss"],
+            rtol=1e-3,
+        )
+
+
 def test_chunked_with_early_stopping_matches():
     members = _members(n=4)
     common = dict(
