@@ -51,11 +51,12 @@ def join_timeseries(
     hard part 2). ``fast=False`` forces the pandas path (used by the
     parity tests)."""
     resolution = _normalize_resolution(resolution)
-    if fast and aggregation == "mean":
-        from gordo_components_tpu.dataset.resample import fused_mean_join
+    if fast:
+        from gordo_components_tpu.dataset.resample import fused_agg_join
 
-        fused = fused_mean_join(
-            series_list, resampling_start, resampling_end, resolution
+        fused = fused_agg_join(
+            series_list, resampling_start, resampling_end, resolution,
+            aggregation,
         )
         if fused is not None:
             return fused

@@ -5,8 +5,9 @@ joining the results (SURVEY.md §3.1 — the per-tag IO/join hot loop inside
 one builder pod). Per-call pandas resample overhead is ~2-3 ms; at fleet
 scale (10k members x 10 tags) that is the host-side staging bottleneck the
 TPU engine exposes (SURVEY.md §7 hard part 2: one process now feeds a whole
-model bank). This module replaces the per-tag loop for the default ``mean``
-aggregation with one numpy pass per tag:
+model bank). This module replaces the per-tag loop for the cheap
+aggregations (mean/sum via bincount, min/max via ufunc.at) with one numpy
+pass per tag:
 
   bucket = floor(timestamp / resolution)        (int64 ns arithmetic)
   sums   = bincount(bucket, weights=values)     (NaN-aware)
@@ -18,8 +19,10 @@ preallocated frame — no intermediate Series, no concat.
 
 Exact-parity constraints (verified in tests/test_resample.py):
 
-- Only ``aggregation == "mean"`` takes the fast path (the default and the
-  reference's documented aggregation); everything else uses pandas.
+- Only ``aggregation in ("mean", "sum", "min", "max")`` takes the fast path
+  (``mean`` is the default and the reference's documented aggregation);
+  everything else — and integer dtypes under the non-mean aggs, whose
+  pandas results stay integral — uses pandas.
 - Only resolutions that evenly divide one day are eligible: pandas
   ``resample`` uses ``origin='start_day'``, which coincides with epoch
   flooring exactly when the step divides 24h (10min, 1min, 1h, 1d, ...)
@@ -54,14 +57,23 @@ def _eligible_index(index: pd.Index) -> bool:
     return str(index.tz) == "UTC"
 
 
-def fused_mean_join(
+# aggregations with a fused single-pass implementation; everything else
+# (median, custom callables, ...) falls back to pandas
+_FUSED_AGGS = ("mean", "sum", "min", "max")
+
+
+def fused_agg_join(
     series_list: List[pd.Series],
     resampling_start: pd.Timestamp,
     resampling_end: pd.Timestamp,
     resolution: str,
+    aggregation: str = "mean",
 ) -> Optional[Tuple[pd.DataFrame, Dict[str, Any]]]:
-    """Fused resample(mean)+outer-join. Returns None when ineligible
+    """Fused resample(aggregation)+outer-join for the affine-cheap
+    aggregations (mean/sum/min/max). Returns None when ineligible
     (caller falls back to the pandas path)."""
+    if aggregation not in _FUSED_AGGS:
+        return None
     try:
         res_ns = int(pd.Timedelta(resolution).value)
     except ValueError:
@@ -114,6 +126,15 @@ def fused_mean_join(
         if index_name is None:
             index_name = series.index.name
 
+        if aggregation != "mean" and series.dtype not in (
+            np.float32, np.float64
+        ):
+            # sum/min/max preserve integer dtypes in pandas (even through
+            # empty resamples), which the NaN-based join representation
+            # cannot — fall back BEFORE any window slicing so the
+            # out-of-window case keeps pandas dtypes too
+            return None
+
         # asi8 is in the index's own unit (ns/us/ms/s in pandas 2.x);
         # normalize to ns for the bucket arithmetic
         units.add(getattr(series.index, "unit", "ns"))
@@ -144,14 +165,29 @@ def fused_mean_join(
             # object/extension dtypes: let pandas define the behavior
             return None
         good = ~np.isnan(fvals)
-        counts = np.bincount(offs[good], minlength=n)
-        sums = np.bincount(offs[good], weights=fvals[good], minlength=n)
-        with np.errstate(invalid="ignore", divide="ignore"):
-            mean = sums / counts  # count==0 -> NaN, matching pandas
-        # pandas preserves float32 through groupby-mean; ints widen to float64
+        if aggregation == "mean":
+            counts = np.bincount(offs[good], minlength=n)
+            sums = np.bincount(offs[good], weights=fvals[good], minlength=n)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                agg = sums / counts  # count==0 -> NaN, matching pandas
+        elif aggregation == "sum":
+            # empty/all-NaN buckets inside the range sum to 0.0 (pandas
+            # skipna with min_count=0)
+            agg = np.bincount(offs[good], weights=fvals[good], minlength=n)
+        else:  # min / max: NaN where a bucket has no real values
+            fill = np.inf if aggregation == "min" else -np.inf
+            agg = np.full(n, fill)
+            ufunc = np.minimum if aggregation == "min" else np.maximum
+            ufunc.at(agg, offs[good], fvals[good])
+            # empty buckets -> NaN, detected by COUNT (comparing against
+            # the fill sentinel would also clobber genuine +/-inf data)
+            nvals = np.bincount(offs[good], minlength=n)
+            agg[nvals == 0] = np.nan
+        # pandas preserves float32 through these aggs; ints widen only
+        # under mean (other int aggs fell back above)
         out_dtype = series.dtype if series.dtype == np.float32 else np.float64
         meta[str(name)]["rows_resampled"] = n
-        cols.append((name, out_dtype, lo, mean.astype(out_dtype, copy=False)))
+        cols.append((name, out_dtype, lo, agg.astype(out_dtype, copy=False)))
 
     if aware_seen and naive_seen:
         # mixed tz-ness across series: pandas concat semantics are messy

@@ -1,13 +1,14 @@
 """Parity tests: the fused numpy resample+join fast path must match the
-pandas reference path exactly (values, index, dtypes) for the ``mean``
-aggregation, across ragged ranges, gaps, NaNs, and dtype mixes."""
+pandas reference path exactly (values, index, dtypes) for every fused
+aggregation (mean/sum/min/max), across ragged ranges, gaps, NaNs, and
+dtype mixes."""
 
 import numpy as np
 import pandas as pd
 import pytest
 
 from gordo_components_tpu.dataset.datasets import join_timeseries
-from gordo_components_tpu.dataset.resample import fused_mean_join
+from gordo_components_tpu.dataset.resample import fused_agg_join
 
 START = pd.Timestamp("2020-01-01", tz="UTC")
 END = pd.Timestamp("2020-02-01", tz="UTC")
@@ -117,13 +118,13 @@ def test_fallback_on_naive_index_with_aware_bounds():
     # the case back rather than silently assume UTC
     idx = pd.date_range("2020-01-01", periods=50, freq="10min")
     s = pd.Series(np.arange(50.0), index=idx, name="naive")
-    assert fused_mean_join([s], START, END, "10min") is None
+    assert fused_agg_join([s], START, END, "10min") is None
 
 
 def test_fallback_on_duplicate_tag_names():
     a = _series(0, 100, "dup")
     b = _series(1, 100, "dup")
-    assert fused_mean_join([a, b], START, END, "10min") is None
+    assert fused_agg_join([a, b], START, END, "10min") is None
     # the pandas path keeps both columns
     df, _ = join_timeseries([a, b], START, END, "10min")
     assert list(df.columns) == ["dup", "dup"]
@@ -131,17 +132,77 @@ def test_fallback_on_duplicate_tag_names():
 
 def test_fallback_on_non_day_dividing_resolution():
     series = [_series(0, 100, "t")]
-    assert fused_mean_join(series, START, END, "7min") is None
+    assert fused_agg_join(series, START, END, "7min") is None
     # join_timeseries still works via pandas
     df, _ = join_timeseries(series, START, END, "7min")
     assert len(df) > 0
 
 
-def test_fallback_on_non_mean_aggregation():
-    series = [_series(0, 400, "t")]
-    df_max, _ = join_timeseries(series, START, END, "10min", aggregation="max")
-    df_mean, _ = join_timeseries(series, START, END, "10min")
-    assert (df_max["t"].dropna() >= df_mean["t"].dropna()).all()
+@pytest.mark.parametrize("agg", ["sum", "min", "max"])
+def test_parity_other_fused_aggregations(agg):
+    """sum/min/max also take the fast path with exact pandas parity,
+    including NaN values and float32 columns."""
+    s1 = _series(0, 1200, "f32", dtype="float32")
+    s2 = _series(1, 1200, "with-nans")
+    vals = s2.values.copy()
+    vals[::7] = np.nan
+    s2 = pd.Series(vals, index=s2.index, name="with-nans")
+    fast_df, fm = join_timeseries([s1, s2], START, END, "10min",
+                                  aggregation=agg, fast=True)
+    ref_df, rm = join_timeseries([s1, s2], START, END, "10min",
+                                 aggregation=agg, fast=False)
+    pd.testing.assert_frame_equal(fast_df, ref_df, check_freq=False)
+    assert fm == rm
+    # sanity: the fast path genuinely engaged
+    assert fused_agg_join([s1, s2], START, END, "10min", agg) is not None
+
+
+def test_non_mean_int_series_falls_back():
+    # pandas keeps integer dtypes through sum/min/max; the NaN-based join
+    # cannot, so ints take the pandas path (and still work end-to-end)
+    s = _series(2, 300, "ints")
+    ints = pd.Series(
+        np.random.RandomState(8).randint(0, 50, size=s.size),
+        index=s.index, name="ints",
+    )
+    assert fused_agg_join([ints], START, END, "10min", "sum") is None
+    df, _ = join_timeseries([ints], START, END, "10min", aggregation="sum")
+    assert len(df) > 0
+
+
+def test_parity_min_max_with_infinite_values():
+    # a bucket holding only +/-inf samples must aggregate to inf like
+    # pandas, not be mistaken for an empty bucket (fill-sentinel collision)
+    idx = pd.date_range("2020-01-01", periods=6, freq="10min", tz="UTC")
+    s = pd.Series(
+        [np.inf, np.inf, 5.0, -np.inf, 2.0, np.nan], index=idx, name="t"
+    )
+    for agg in ("min", "max"):
+        fast_df, _ = join_timeseries([s], START, END, "10min",
+                                     aggregation=agg, fast=True)
+        ref_df, _ = join_timeseries([s], START, END, "10min",
+                                    aggregation=agg, fast=False)
+        pd.testing.assert_frame_equal(fast_df, ref_df, check_freq=False)
+
+
+def test_out_of_window_int_non_mean_falls_back():
+    # an int series entirely outside the window keeps its int64 dtype
+    # through pandas sum; the fused path must hand the case back
+    idx = pd.date_range("2021-06-01", periods=50, freq="10min", tz="UTC")
+    ints = pd.Series(np.arange(50), index=idx, name="ints")
+    assert fused_agg_join([ints], START, END, "10min", "sum") is None
+    fast_df, _ = join_timeseries([ints], START, END, "10min",
+                                 aggregation="sum", fast=True)
+    ref_df, _ = join_timeseries([ints], START, END, "10min",
+                                aggregation="sum", fast=False)
+    pd.testing.assert_frame_equal(fast_df, ref_df, check_freq=False)
+
+
+def test_fallback_on_unsupported_aggregation():
+    series = [_series(0, 200, "t")]
+    assert fused_agg_join(series, START, END, "10min", "median") is None
+    df, _ = join_timeseries(series, START, END, "10min", aggregation="median")
+    assert len(df) > 0
 
 
 def test_parity_date_range_index_unit():
@@ -214,8 +275,13 @@ def test_parity_fuzz_sweep():
                 )
             )
         res = resolutions[int(rng.randint(len(resolutions)))]
-        fast_df, fast_meta = join_timeseries(series, START, END, res, fast=True)
-        ref_df, ref_meta = join_timeseries(series, START, END, res, fast=False)
+        agg = ["mean", "sum", "min", "max"][int(rng.randint(4))]
+        fast_df, fast_meta = join_timeseries(
+            series, START, END, res, aggregation=agg, fast=True
+        )
+        ref_df, ref_meta = join_timeseries(
+            series, START, END, res, aggregation=agg, fast=False
+        )
         pd.testing.assert_frame_equal(
             fast_df, ref_df, check_freq=False,
             obj=f"trial {trial} ({n_series} series, {res})",
@@ -228,7 +294,7 @@ def test_fast_path_is_used_and_not_slower():
 
     series = [_series(i, 4000, f"tag-{i}") for i in range(10)]
     # the fast path must actually engage for this (typical) input
-    assert fused_mean_join(series, START, END, "10min") is not None
+    assert fused_agg_join(series, START, END, "10min") is not None
     t0 = time.perf_counter()
     for _ in range(3):
         join_timeseries(series, START, END, "10min", fast=True)
