@@ -76,6 +76,89 @@ def _leaves(tree):
     return jax.tree.leaves(tree)
 
 
+def _counting_cb():
+    """Records which epoch indices the trainer actually RAN — the proof a
+    resume genuinely skipped completed epochs (a silent restore failure
+    retrains from 0 with identical outputs on a same-seed run, so output
+    equality alone cannot detect it)."""
+    epochs: list = []
+
+    def cb(info):
+        epochs.append(int(info["epoch"]))
+
+    return epochs, cb
+
+
+def test_seq_fleet_resume_matches_uninterrupted_run(tmp_path):
+    """Preemption recovery must be family-agnostic: a gather-windowed LSTM
+    fleet resumed from its checkpoint ends bit-close to the uninterrupted
+    run (checkpoint keys carry model_type/lookback) — and genuinely
+    resumes rather than retraining from scratch."""
+    members = _members(n=4, rows=80)
+    common = dict(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(6,),
+        lookback_window=8, epochs=4, batch_size=32, seed=3,
+    )
+    reference = FleetTrainer(**common).fit(members)
+
+    ckdir = str(tmp_path / "ck")
+    t1 = FleetTrainer(
+        **common, checkpoint_dir=ckdir, checkpoint_every=1,
+        epoch_callback=_kill_after(2),
+    )
+    with pytest.raises(_Preempt):
+        t1.fit(members)
+    assert os.listdir(ckdir)
+
+    ran, cb = _counting_cb()
+    resumed = FleetTrainer(
+        **common, checkpoint_dir=ckdir, checkpoint_every=1, epoch_callback=cb
+    ).fit(members)
+    # killed during epoch 1's callback -> epoch 0's save committed ->
+    # the resume must run ONLY epochs 1..3
+    assert ran == [1, 2, 3], ran
+    for name in members:
+        assert len(resumed[name].history["loss"]) == 4
+        np.testing.assert_allclose(
+            resumed[name].history["loss"], reference[name].history["loss"],
+            rtol=1e-4,
+        )
+        for a, b in zip(_leaves(reference[name].params), _leaves(resumed[name].params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+
+
+def test_seq_lookback_change_invalidates_checkpoint(tmp_path):
+    """A different lookback must never resume another lookback's state."""
+    members = _members(n=2, rows=80)
+    base = dict(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(6,),
+        epochs=2, batch_size=32, seed=0,
+    )
+    ckdir = str(tmp_path / "ck")
+    t1 = FleetTrainer(
+        # kill during epoch 1's callback so epoch 0's save COMMITS (the
+        # callback precedes the save, so killing at epoch 0 would leave
+        # no checkpoint at all and make this test vacuous)
+        **base, lookback_window=8, checkpoint_dir=ckdir, checkpoint_every=1,
+        epoch_callback=_kill_after(2),
+    )
+    with pytest.raises(_Preempt):
+        t1.fit(members)
+    assert os.listdir(ckdir)
+    # different lookback: a FRESH run executing every epoch (a wrong resume
+    # of the lookback-8 state would skip epoch 0 and be caught here)
+    ran, cb = _counting_cb()
+    out = FleetTrainer(
+        **base, lookback_window=12, checkpoint_dir=ckdir, checkpoint_every=1,
+        epoch_callback=cb,
+    ).fit(members)
+    assert ran == [0, 1], ran
+    for m in out.values():
+        assert len(m.history["loss"]) == 2
+
+
 def test_resume_with_early_stopping_state(tmp_path):
     members = _members(n=4)
     common = dict(
