@@ -59,6 +59,22 @@ async def test_watchman_aggregates_bank_coverage(collection_dir, live_server):
             assert entry["banked"] is True
 
 
+async def test_watchman_explicit_targets_with_unknown(collection_dir, live_server):
+    """Explicit target lists still get coverage flags; a target the
+    collection doesn't know is explicitly marked unknown (None), not
+    silently unlabeled."""
+    async with live_server(collection_dir) as base_url:
+        body = await WatchmanState(
+            "proj", base_url, targets=["m-1", "ghost"]
+        ).snapshot()
+    by_target = {e["target"]: e for e in body["endpoints"]}
+    assert set(by_target) == {"m-1", "ghost"}
+    assert by_target["m-1"]["banked"] in (True, False)
+    assert by_target["ghost"]["banked"] is None
+    assert by_target["ghost"]["healthy"] is False
+    assert "bank" in body
+
+
 async def test_watchman_marks_unreachable_unhealthy():
     # nothing listens on this port; targets are explicit (the coverage-only
     # /models fetch fails quietly alongside the health polls)
