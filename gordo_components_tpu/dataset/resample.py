@@ -138,7 +138,12 @@ def fused_agg_join(
         # asi8 is in the index's own unit (ns/us/ms/s in pandas 2.x);
         # normalize to ns for the bucket arithmetic
         units.add(getattr(series.index, "unit", "ns"))
-        ts = series.index.as_unit("ns").asi8
+        try:
+            ts = series.index.as_unit("ns").asi8
+        except (pd.errors.OutOfBoundsDatetime, OverflowError):
+            # far-range timestamps in a coarser unit don't fit int64 ns;
+            # pandas resamples in the native unit, so hand the case back
+            return None
         keep = (ts >= start_ns) & (ts < end_ns)
         ts = ts[keep]
         vals = np.asarray(series.values)[keep]

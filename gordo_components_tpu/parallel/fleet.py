@@ -25,6 +25,7 @@ and the client treat fleet-trained models identically to single builds.
 import functools
 import logging
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -125,9 +126,14 @@ class _BucketPrograms:
         self._vm_epoch = jax.vmap(masked_epoch)
         self.run_epoch = jax.jit(jax.vmap(masked_epoch), donate_argnums=(0,))
 
-        # per-member validation loss: the same global masked mean eval_fn
-        # computes batchwise in the single-model path (models/models.py),
-        # so fleet val-loss ES has identical semantics to BaseEstimator.fit's
+        # per-member validation loss, same loss family and masked-mean
+        # semantics as the single path's make_eval_fn. One deliberate
+        # deviation: this evaluates in ONE full-block pass with a single
+        # fixed rng draw, while make_eval_fn evaluates batchwise with a
+        # per-batch fixed rng — for MSE the results agree to fp rounding,
+        # but variational (ELBO) members sample different noise, so VAE
+        # val losses are deterministic yet not bitwise the single-path
+        # values and ES decisions can diverge slightly on a VAE fleet.
         if seq is None:
             # same loss family as training (VAE members validate with the
             # ELBO, like make_eval_fn's fixed-rng pass in the single path)
@@ -370,13 +376,18 @@ def _family_defaults(model_type: str) -> Tuple[str, int]:
     lb_param = sig.parameters.get("lookback_window")
     return kind, (int(lb_param.default) if lb_param is not None else 1)
 
-_PROGRAM_CACHE: Dict[Any, _BucketPrograms] = {}
+_PROGRAM_CACHE: "OrderedDict[Any, _BucketPrograms]" = OrderedDict()
+_PROGRAM_CACHE_MAX = 128
+# monotone count of _BucketPrograms builds: lets tests (and operators
+# debugging recompile storms) assert whether a fit hit the cache
+_PROGRAM_BUILDS = 0
 
 
 def _bucket_programs(
     module, opt_name: str, lr: float, batch_size: int, seq=None,
     loss: str = "mse", kl_weight: float = 1.0, threshold_quantile: float = 1.0,
 ) -> _BucketPrograms:
+    global _PROGRAM_BUILDS
     key = (
         module, opt_name, float(lr), int(batch_size), seq, loss,
         float(kl_weight), float(threshold_quantile),
@@ -384,17 +395,24 @@ def _bucket_programs(
     try:
         prog = _PROGRAM_CACHE.get(key)
     except TypeError:  # unhashable factory kwargs: build uncached
+        _PROGRAM_BUILDS += 1
         return _BucketPrograms(
             module, opt_name, lr, batch_size, seq, loss, kl_weight,
             threshold_quantile,
         )
     if prog is None:
-        if len(_PROGRAM_CACHE) >= 128:  # bound on pathological churn
-            _PROGRAM_CACHE.clear()
+        # LRU bound: a long-lived gang builder cycling many configs keeps
+        # its hot programs warm instead of recompiling everything from zero
+        # after a wholesale wipe
+        while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.popitem(last=False)
+        _PROGRAM_BUILDS += 1
         prog = _PROGRAM_CACHE[key] = _BucketPrograms(
             module, opt_name, lr, batch_size, seq, loss, kl_weight,
             threshold_quantile,
         )
+    else:
+        _PROGRAM_CACHE.move_to_end(key)
     return prog
 
 
@@ -629,7 +647,8 @@ class FleetTrainer:
     def fit(self, members: Dict[str, np.ndarray]) -> Dict[str, FleetMemberModel]:
         """``members``: name -> (n_rows_i, n_features_i) float array.
         Returns name -> FleetMemberModel. One compiled program per
-        (n_features, padded_rows) bucket."""
+        (n_features, padded_items) bucket, where items are the training
+        units (rows for the dense family, window starts for sequences)."""
         t0 = time.time()
         buckets: Dict[Tuple[int, int], List[str]] = {}
         # accept DataFrames: keep tag names for the anomaly contract
@@ -689,7 +708,12 @@ class FleetTrainer:
             bucket_stats.append(
                 {
                     "n_features": n_features,
-                    "padded_rows": padded_rows,
+                    # the bucket key counts ITEMS (training units: rows for
+                    # the dense family, window starts for sequences);
+                    # padded_rows is the actual padded row block (items +
+                    # warmup), so the two differ for sequence fleets
+                    "padded_items": padded_rows,
+                    "padded_rows": padded_rows + warmup,
                     "n_members": len(names),
                     "seconds": time.time() - tb,
                     # structured per-epoch timing: epoch 0 includes the XLA

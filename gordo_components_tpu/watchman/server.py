@@ -84,14 +84,23 @@ class WatchmanState:
                 # coverage (which models score from the stacked bank vs
                 # the per-model fallback, and why) — fetched even with an
                 # explicit target list so operators see serving coverage
-                # fleet-wide, but then CONCURRENTLY with the health poll
-                # so a hung collection endpoint can't stall the refresh
+                # fleet-wide. With an explicit list it runs concurrently
+                # with the health poll AND under its own short deadline:
+                # the outer gather still waits for it, so without the
+                # wait_for a hung collection endpoint would stall the
+                # refresh by the full 30s client timeout for data that is
+                # coverage-only decoration.
 
-                async def fetch_models():
-                    async with session.get(
-                        f"{self.base_url}/gordo/v0/{self.project}/models"
-                    ) as resp:
-                        return await resp.json()
+                async def fetch_models(deadline: Optional[float] = None):
+                    async def get():
+                        async with session.get(
+                            f"{self.base_url}/gordo/v0/{self.project}/models"
+                        ) as resp:
+                            return await resp.json()
+
+                    if deadline is None:
+                        return await get()
+                    return await asyncio.wait_for(get(), timeout=deadline)
 
                 bank = None
                 targets = self.targets
@@ -111,7 +120,7 @@ class WatchmanState:
                         asyncio.gather(
                             *(self._check_target(session, sem, t) for t in targets)
                         ),
-                        fetch_models(),
+                        fetch_models(deadline=10.0),
                         return_exceptions=True,
                     )
                     if isinstance(results, BaseException):
