@@ -103,14 +103,14 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     the estimator kwargs for FleetTrainer, augmented with the honored
     routing kwargs (``input_scaler`` for the z-score scaler, ``model_type``
     for sequence families, ``threshold_quantile``/``require_thresholds``
-    detector knobs the fleet computes identically); else None (single-build
-    path).
+    detector knobs — quantile thresholds are exact for the dense family
+    and histogram-approximate (one-bin-width tolerance) for sequence
+    families); else None (single-build path).
 
     The check is deliberately strict: the fleet engine fits exactly the
     default min-max or z-score affine, so any config that deviates (unknown
     detector or estimator kwargs, scaler kwargs, no scaler step, bare base
-    estimator, sequence family with a non-default quantile) must take the
-    single-build path to keep identical semantics.
+    estimator) must take the single-build path to keep identical semantics.
     """
     if not isinstance(model_config, dict) or len(model_config) != 1:
         return None
@@ -152,10 +152,6 @@ def extract_fleetable(model_config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             ae = dict(ae, input_scaler=scaler_kind)
         if model_type != "AutoEncoder":
             ae = dict(ae, model_type=model_type)
-            if float(det_kwargs.get("threshold_quantile", 1.0)) != 1.0:
-                # sequence error thresholds stream; exact quantiles need
-                # the single-build path
-                return None
         if det_kwargs:
             ae = dict(ae, **det_kwargs)
         return ae

@@ -89,6 +89,42 @@ def _merge_best(best_p, new_p, improved):
     return _select_improved(improved, best_p, new_p)
 
 
+# Bin count for the streaming-quantile histograms of the sequence error
+# pass: absolute threshold error <= range/8192 (~1.2e-4 on the [0,1]
+# scaled-feature axis), with (f+1)*8192 int32 histogram cells per member.
+_QUANTILE_BINS = 8192
+# Transient histogram budget for one vmapped quantile pass; wider fleets
+# stream through run_error_scalers in member chunks under this cap.
+_QUANTILE_CHUNK_BYTES = 1 << 28
+
+
+def _hist_quantile(hist, binw, q, n):
+    """Approximate ``np.quantile(values, q)`` (linear interpolation
+    between order statistics) from a fixed-bin histogram of the values
+    over ``[0, len(hist)*binw)`` holding ``n`` valid samples: each order
+    statistic is located by inverting the empirical CDF with
+    uniform-within-bin interpolation, so the absolute error is bounded by
+    one bin width. ``hist`` accumulates in int32 (f32 scatter-adds would
+    saturate at 2^24 and silently push high quantiles to the range max);
+    the f32 conversion here costs only ~1e-7 relative rank error."""
+    cum = jnp.cumsum(hist).astype(jnp.float32)
+    hist = hist.astype(jnp.float32)
+
+    def order_stat(j):  # j: float 0-indexed rank
+        b = jnp.clip(
+            jnp.searchsorted(cum, j + 1.0, side="left"), 0, hist.shape[0] - 1
+        )
+        prev = jnp.where(b > 0, cum[b - 1], 0.0)
+        frac = jnp.clip((j + 1.0 - prev) / jnp.maximum(hist[b], 1.0), 0.0, 1.0)
+        return (b.astype(jnp.float32) + frac) * binw
+
+    p = q * (n - 1.0)
+    j0 = jnp.floor(p)
+    g = p - j0
+    j1 = jnp.minimum(j0 + 1.0, jnp.maximum(n - 1.0, 0.0))
+    return (1.0 - g) * order_stat(j0) + g * order_stat(j1)
+
+
 class _BucketPrograms:
     """All compiled programs for one (module, optimizer, batch-size[, seq])
     key. ``seq=(lookback, target_offset)`` switches every program to the
@@ -152,12 +188,38 @@ class _BucketPrograms:
 
         self._vm_eval = jax.vmap(member_val_loss)
         self.eval_stacked = jax.jit(self._vm_eval)
+        self.threshold_quantile = float(threshold_quantile)
         self.fit_error_scalers = (
             self._make_error_scalers(module, threshold_quantile)
             if seq is None
-            else self._make_seq_error_scalers(module, batch_size, *seq)
+            else self._make_seq_error_scalers(
+                module, batch_size, *seq, q=threshold_quantile
+            )
         )
         self._chunks: Dict[Tuple, Any] = {}
+
+    def run_error_scalers(self, params, X, mask):
+        """``fit_error_scalers``, chunked over members for the sequence
+        ``q < 1`` histogram pass: its (f+1)*8192-cell per-member scan
+        carry scales the transient with the vmap width, so wide fleets
+        stream through in member chunks capped at ~256 MB of histogram
+        (at most two extra compiles: the chunk shape and the tail)."""
+        if self.seq is None or self.threshold_quantile >= 1.0:
+            return self.fit_error_scalers(params, X, mask)
+        f = X.shape[-1]
+        M = X.shape[0]
+        ch = max(1, _QUANTILE_CHUNK_BYTES // ((f + 1) * _QUANTILE_BINS * 4))
+        if M <= ch:
+            return self.fit_error_scalers(params, X, mask)
+        outs = []
+        for i in range(0, M, ch):
+            sl = slice(i, min(i + ch, M))
+            outs.append(
+                self.fit_error_scalers(
+                    jax.tree.map(lambda a: a[sl], params), X[sl], mask[sl]
+                )
+            )
+        return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *outs)
 
     @staticmethod
     def _make_error_scalers(module, q: float = 1.0):
@@ -186,11 +248,22 @@ class _BucketPrograms:
         return fit_error_scalers
 
     @staticmethod
-    def _make_seq_error_scalers(module, batch_size, lookback, t_offset):
+    def _make_seq_error_scalers(module, batch_size, lookback, t_offset, q=1.0):
         """Two scan passes (min/max of |err|, then scaled thresholds) so
         windows are never materialized beyond one batch — the same anomaly
         contract as the dense path: es = minmax over training |err|,
-        feature thresholds = max scaled |err|, total = max scaled norm."""
+        feature thresholds = max scaled |err| (``q >= 1``), total = max
+        scaled norm.
+
+        ``q < 1``: thresholds are STREAMING APPROXIMATE quantiles. The
+        scaled per-feature errors lie exactly in [0, 1] (the scaler is the
+        min-max of the same errors) and the scaled norm in [0, sqrt(f)],
+        so pass 2 accumulates fixed-bin histograms over those known ranges
+        and inverts the empirical CDF with the same linear order-statistic
+        interpolation ``np.quantile`` uses — absolute error bounded by one
+        bin width (range/8192), vs the single-build detector's exact
+        ``np.quantile`` over materialized windows (models/anomaly/diff.py).
+        """
         @jax.jit
         def fit_error_scalers(params, X, mask):
             def one(p, x, m):
@@ -224,23 +297,71 @@ class _BucketPrograms:
                 span = jnp.where(jnp.abs(dmax - dmin) < 1e-12, 1.0, dmax - dmin)
                 es = ScalerParams(shift=dmin, scale=1.0 / span)
 
-                def pass2(carry, batch):
-                    ft, tt = carry
-                    d = diff_batch(*batch)
-                    scaled = scaler_transform(es, d)
-                    total = jnp.sqrt(jnp.nansum(scaled**2, axis=-1))
-                    # all-NaN (padded) rows: nansum=0 -> exclude via mask
-                    total = jnp.where(jnp.isnan(d).all(axis=-1), jnp.nan, total)
-                    return (
-                        jnp.fmax(ft, jnp.nanmax(scaled, axis=0)),
-                        jnp.fmax(tt, jnp.nanmax(total)),
-                    ), None
+                if q >= 1.0:
 
-                (feat_thresh, total_thresh), _ = jax.lax.scan(
-                    pass2,
-                    (jnp.full((f,), -jnp.inf), jnp.float32(-jnp.inf)),
+                    def pass2(carry, batch):
+                        ft, tt = carry
+                        d = diff_batch(*batch)
+                        scaled = scaler_transform(es, d)
+                        total = jnp.sqrt(jnp.nansum(scaled**2, axis=-1))
+                        # all-NaN (padded) rows: nansum=0 -> exclude via mask
+                        total = jnp.where(
+                            jnp.isnan(d).all(axis=-1), jnp.nan, total
+                        )
+                        return (
+                            jnp.fmax(ft, jnp.nanmax(scaled, axis=0)),
+                            jnp.fmax(tt, jnp.nanmax(total)),
+                        ), None
+
+                    (feat_thresh, total_thresh), _ = jax.lax.scan(
+                        pass2,
+                        (jnp.full((f,), -jnp.inf), jnp.float32(-jnp.inf)),
+                        (idxs, Ms),
+                    )
+                    return es, feat_thresh, total_thresh
+
+                # approximate quantile: histogram the scaled errors over
+                # their statically known ranges ([0,1] per feature,
+                # [0,sqrt(f)] for the norm) in one extra streamed pass
+                B = _QUANTILE_BINS
+                tmax = jnp.sqrt(jnp.float32(f))
+
+                def pass2q(carry, batch):
+                    hf, ht = carry
+                    ib, mb = batch
+                    d = diff_batch(ib, mb)
+                    scaled = scaler_transform(es, d)
+                    valid = mb > 0
+                    # int32 counts: f32 scatter-adds saturate at 2^24
+                    w = valid.astype(jnp.int32)
+                    s = jnp.where(valid[:, None], scaled, 0.0)
+                    sb = jnp.clip(jnp.floor(s * B), 0, B - 1).astype(jnp.int32)
+                    fcols = jnp.broadcast_to(
+                        jnp.arange(f, dtype=jnp.int32)[None, :], sb.shape
+                    )
+                    hf = hf.at[fcols, sb].add(
+                        jnp.broadcast_to(w[:, None], sb.shape)
+                    )
+                    total = jnp.sqrt(jnp.sum(s * s, axis=-1))
+                    tb = jnp.clip(
+                        jnp.floor(total / tmax * B), 0, B - 1
+                    ).astype(jnp.int32)
+                    ht = ht.at[tb].add(w)
+                    return (hf, ht), None
+
+                (hf, ht), _ = jax.lax.scan(
+                    pass2q,
+                    (
+                        jnp.zeros((f, B), jnp.int32),
+                        jnp.zeros((B,), jnp.int32),
+                    ),
                     (idxs, Ms),
                 )
+                n = jnp.sum(m)
+                feat_thresh = jax.vmap(
+                    lambda h: _hist_quantile(h, 1.0 / B, q, n)
+                )(hf)
+                total_thresh = _hist_quantile(ht, tmax / B, q, n)
                 return es, feat_thresh, total_thresh
 
             return jax.vmap(one)(params, X, mask)
@@ -584,8 +705,10 @@ class FleetTrainer:
         self.loss = loss
         self.kl_weight = float(kl_weight)
         # detector knobs, honored so quantile-threshold configs keep fleet
-        # speed; the sequence error pass streams (no exact quantiles), so
-        # non-default quantiles are dense-family only
+        # speed. Dense-family quantiles are exact (jnp.nanquantile over the
+        # full error block); sequence-family quantiles stream over window
+        # chunks via fixed-bin histograms, approximate to within one bin
+        # width of the scaled-error range (_make_seq_error_scalers).
         self.threshold_quantile = float(threshold_quantile)
         if not 0.0 <= self.threshold_quantile <= 1.0:
             # fail fast with the same contract np.quantile enforces in the
@@ -594,12 +717,6 @@ class FleetTrainer:
                 f"threshold_quantile must be in [0, 1], got {threshold_quantile}"
             )
         self.require_thresholds = bool(require_thresholds)
-        if self.threshold_quantile != 1.0 and model_type != "AutoEncoder":
-            raise ValueError(
-                "threshold_quantile != 1.0 requires the dense family "
-                "(sequence error thresholds stream over window chunks); "
-                "use the single-build path"
-            )
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
@@ -1107,7 +1224,7 @@ class FleetTrainer:
         # vmapped pass (parity with DiffBasedAnomalyDetector.fit, which
         # records max scaled training error as the default threshold);
         # item mask == row mask for the dense family ----
-        err_scalers, feat_thresh, total_thresh = progs.fit_error_scalers(
+        err_scalers, feat_thresh, total_thresh = progs.run_error_scalers(
             final_params, Xd, item_maskd
         )
         feat_thresh = np.asarray(feat_thresh)

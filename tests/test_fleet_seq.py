@@ -366,11 +366,76 @@ class TestThresholdQuantile:
             det = m.to_estimator()
             assert det.threshold_quantile == q
 
-    def test_sequence_quantile_rejected(self):
-        with pytest.raises(ValueError, match="dense family"):
-            FleetTrainer(
-                model_type="LSTMAutoEncoder", threshold_quantile=0.9
+    @pytest.mark.parametrize("q", [0.5, 0.9, 0.99])
+    def test_sequence_quantile_thresholds_match_recompute(self, q):
+        """Sequence-fleet quantile thresholds stream through fixed-bin
+        histograms; they must match np.quantile over the member's own
+        materialized windowed scaled errors to within one bin width
+        (range/8192) — the documented approximation contract."""
+        import jax.numpy as jnp
+
+        from gordo_components_tpu.models import train_core
+        from gordo_components_tpu.native import sliding_windows_host
+        from gordo_components_tpu.ops.scaler import ScalerParams, scaler_transform
+        from gordo_components_tpu.parallel.fleet import _QUANTILE_BINS
+
+        members = _seq_members(2, rows=96)
+        models = FleetTrainer(
+            model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(8,),
+            lookback_window=LOOKBACK, epochs=2, batch_size=32, seed=0,
+            threshold_quantile=q,
+        ).fit(members)
+        for name, m in models.items():
+            Xs = np.asarray(
+                scaler_transform(
+                    ScalerParams(*m.scaler), jnp.asarray(members[name])
+                )
             )
+            W = sliding_windows_host(Xs, LOOKBACK)
+            pred = train_core.batched_apply(m._module(), m.params, W)
+            target = Xs[LOOKBACK - 1 :]
+            diff = np.abs(target - pred)
+            scaled = np.asarray(
+                scaler_transform(ScalerParams(*m.error_scaler), jnp.asarray(diff))
+            )
+            f = scaled.shape[-1]
+            binw = 1.0 / _QUANTILE_BINS
+            np.testing.assert_allclose(
+                m.feature_thresholds, np.quantile(scaled, q, axis=0),
+                atol=2 * binw,
+            )
+            np.testing.assert_allclose(
+                m.total_threshold,
+                np.quantile(np.linalg.norm(scaled, axis=-1), q),
+                atol=2 * binw * np.sqrt(f),
+            )
+            det = m.to_estimator()
+            assert det.threshold_quantile == q
+
+    def test_chunked_quantile_pass_matches_unchunked(self, monkeypatch):
+        """run_error_scalers streams wide fleets through the histogram
+        pass in member chunks; chunked and one-shot results must agree
+        bit-for-bit (chunking only re-slices the vmap width)."""
+        from gordo_components_tpu.parallel import fleet as fleet_mod
+
+        members = _seq_members(5, rows=64)
+        config = dict(
+            model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(8,),
+            lookback_window=LOOKBACK, epochs=1, batch_size=32, seed=0,
+            threshold_quantile=0.9,
+        )
+        whole = FleetTrainer(**config).fit(members)
+        # force a 2-member chunk size so the same fit streams in chunks
+        monkeypatch.setattr(
+            fleet_mod, "_QUANTILE_CHUNK_BYTES",
+            2 * (members["m0"].shape[1] + 1) * fleet_mod._QUANTILE_BINS * 4,
+        )
+        chunked = FleetTrainer(**config).fit(members)
+        for name in members:
+            np.testing.assert_array_equal(
+                whole[name].feature_thresholds, chunked[name].feature_thresholds
+            )
+            assert whole[name].total_threshold == chunked[name].total_threshold
 
     def test_out_of_range_quantile_rejected_up_front(self):
         # must fail BEFORE any gang training, like np.quantile would in
@@ -391,17 +456,18 @@ class TestThresholdQuantile:
         assert out is not None and out["threshold_quantile"] == 0.95
         out = extract_fleetable(cfg({"require_thresholds": True}))
         assert out is not None and out["require_thresholds"] is True
-        # sequence + non-default quantile: single path
-        assert (
-            extract_fleetable(
-                cfg(
-                    {"threshold_quantile": 0.95},
-                    est_path="gordo_components_tpu.models.LSTMAutoEncoder",
-                    est_kwargs={"lookback_window": 8},
-                )
+        # sequence + non-default quantile: fleet path (streamed
+        # histogram-approximate thresholds)
+        out = extract_fleetable(
+            cfg(
+                {"threshold_quantile": 0.95},
+                est_path="gordo_components_tpu.models.LSTMAutoEncoder",
+                est_kwargs={"lookback_window": 8},
             )
-            is None
         )
+        assert out is not None
+        assert out["threshold_quantile"] == 0.95
+        assert out["model_type"] == "LSTMAutoEncoder"
         # unknown detector kwarg still rejected
         assert extract_fleetable(cfg({"bespoke": 1})) is None
 
