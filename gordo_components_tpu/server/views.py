@@ -54,26 +54,61 @@ def _bank_engine(request: web.Request):
     return None
 
 
+def _bank_coverage(request: web.Request, names) -> Any:
+    """Operator-facing coverage: which models score through the HBM bank
+    vs the per-model fallback path, and why (server/bank.py). None when
+    the bank is disabled."""
+    bank = request.app.get("bank")
+    if bank is None:
+        return None
+    cov = bank.coverage()
+    return {
+        "banked": sorted(n for n in names if n in bank),
+        "fallback": {
+            n: cov["fallback"].get(n, "not bankable")
+            for n in names
+            if n not in bank
+        },
+        "n_buckets": cov["n_buckets"],
+    }
+
+
 @routes.get("/gordo/v0/{project}/models")
 async def list_models(request: web.Request) -> web.Response:
     body = {
         "project": request.match_info["project"],
         "models": _collection(request).names(),
     }
-    bank = request.app.get("bank")
+    bank = _bank_coverage(request, body["models"])
     if bank is not None:
-        # operator-facing coverage: which models score through the HBM
-        # bank vs the per-model fallback path, and why (server/bank.py)
-        cov = bank.coverage()
-        body["bank"] = {
-            "banked": sorted(n for n in body["models"] if n in bank),
-            "fallback": {
-                n: cov["fallback"].get(n, "not bankable")
-                for n in body["models"]
-                if n not in bank
-            },
-            "n_buckets": cov["n_buckets"],
-        }
+        body["bank"] = bank
+    return web.json_response(body)
+
+
+@routes.get("/gordo/v0/{project}/metadata-all")
+async def metadata_all(request: web.Request) -> web.Response:
+    """Every target's health + metadata in ONE response.
+
+    The reference's watchman had to poll one pod per model; against a
+    collection server that per-target pattern costs O(2N) HTTP requests
+    per snapshot (20k requests/30s at the 10k-model north star) hammering
+    the same process that serves scoring traffic. A model present in the
+    collection is loaded and servable, so ``healthy`` mirrors what
+    per-target ``/healthcheck`` (200 iff present) would report."""
+    collection = _collection(request)
+    targets = {}
+    for name in collection.names():
+        # .get(): a concurrent /reload mutates models/metadata on an
+        # executor thread, so a name can momentarily lack its metadata —
+        # skip it (the next snapshot sees the settled state) instead of
+        # 500ing the whole batched response
+        meta = collection.metadata.get(name)
+        if meta is not None:
+            targets[name] = {"healthy": True, "endpoint-metadata": meta}
+    body = {"project": request.match_info["project"], "targets": targets}
+    bank = _bank_coverage(request, collection.names())
+    if bank is not None:
+        body["bank"] = bank
     return web.json_response(body)
 
 

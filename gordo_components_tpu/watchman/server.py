@@ -7,9 +7,12 @@ project's target list, poll each model server's ``/healthcheck`` and
 ``{project_name, endpoints: [{endpoint, healthy, metadata}, ...]}``.
 
 TPU-native notes: with the collection server, many targets share one base
-URL; watchman discovers targets from ``GET /models`` when no explicit list
-is given, and polls with bounded concurrency on the shared event loop.
-Results are cached for ``refresh_interval`` seconds.
+URL; a snapshot costs ONE request to the batched ``metadata-all``
+control-plane endpoint (with reference-style per-target polling, bounded
+concurrency, as the fallback for foreign servers and for explicit targets
+the collection doesn't know). Watchman discovers targets from ``GET
+/models`` when no explicit list is given. Results are cached for
+``refresh_interval`` seconds.
 """
 
 import asyncio
@@ -72,6 +75,31 @@ class WatchmanState:
                 logger.warning("healthcheck failed for %s: %s", target, exc)
         return entry
 
+    async def _fetch_metadata_all(self, session) -> Optional[Dict[str, Any]]:
+        """The collection server's batched control-plane endpoint: every
+        target's health + metadata in ONE request (O(1) per snapshot
+        instead of O(2N) per-target polls hammering the process that also
+        serves scoring traffic). Returns None when the server doesn't
+        speak it (non-200), so foreign per-model servers keep working via
+        the per-target fallback."""
+        try:
+            async with session.get(
+                f"{self.base_url}/gordo/v0/{self.project}/metadata-all"
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
+            # ValueError covers json.JSONDecodeError: a malformed 200 must
+            # fall back, not crash the snapshot
+            logger.debug("metadata-all fetch failed: %s", exc)
+            return None
+        if not isinstance(body, dict) or not isinstance(body.get("targets"), dict):
+            # a catch-all proxy can 200 unknown paths with arbitrary JSON;
+            # treat anything without the contract shape as "not spoken"
+            return None
+        return body
+
     async def snapshot(self) -> Dict[str, Any]:
         async with self._lock:
             now = time.monotonic()
@@ -80,6 +108,12 @@ class WatchmanState:
             timeout = aiohttp.ClientTimeout(total=30)
             sem = asyncio.Semaphore(self.parallelism)
             async with aiohttp.ClientSession(timeout=timeout) as session:
+                batched = await self._fetch_metadata_all(session)
+                if batched is not None:
+                    endpoints, bank = await self._snapshot_from_batched(
+                        session, sem, batched
+                    )
+                    return await self._finish_snapshot(endpoints, bank, now)
                 # /models carries both the target list and the HBM bank
                 # coverage (which models score from the stacked bank vs
                 # the per-model fallback, and why) — fetched even with an
@@ -131,38 +165,74 @@ class WatchmanState:
                         logger.debug("bank coverage fetch failed: %s", models_body)
                     else:
                         bank = models_body.get("bank")
-            endpoints = list(results)
-            if bank is not None:
-                banked = set(bank.get("banked", []))
-                fallback = bank.get("fallback", {})
-                for entry in endpoints:
-                    t = entry["target"]
-                    if t in banked:
-                        entry["banked"] = True
-                    elif t in fallback:
-                        entry["banked"] = False
-                        entry["bank-fallback-reason"] = fallback[t]
-                    else:
-                        entry["banked"] = None  # not known to the collection
-            self._cache = {
-                "project_name": self.project,
-                "gordo-watchman-version": __version__,
-                "endpoints": endpoints,
-            }
-            if bank is not None:
-                self._cache["bank"] = bank
-            if self.gang_state_dir:
-                from gordo_components_tpu.workflow.gang_state import read_gang_states
+            return await self._finish_snapshot(list(results), bank, now)
 
-                gangs = await asyncio.get_running_loop().run_in_executor(
-                    None,
-                    read_gang_states,
-                    self.gang_state_dir,
-                    self.gang_stale_after,
-                )
-                self._cache["gangs"] = gangs
-            self._cache_time = now
-            return self._cache
+    async def _snapshot_from_batched(
+        self, session, sem, batched: Dict[str, Any]
+    ) -> tuple:
+        """Endpoint entries from one ``metadata-all`` response. With an
+        explicit target list, targets the collection doesn't know (e.g.
+        served by a foreign per-model server behind the same base URL)
+        still get individual per-target polls."""
+        tmap = batched.get("targets", {})
+        targets = self.targets if self.targets is not None else sorted(tmap)
+        by_target: Dict[str, Dict[str, Any]] = {}
+        missing = []
+        for t in targets:
+            if t in tmap:
+                entry = {
+                    "endpoint": f"/gordo/v0/{self.project}/{t}/",
+                    "target": t,
+                    "healthy": bool(tmap[t].get("healthy", False)),
+                }
+                if "endpoint-metadata" in tmap[t]:
+                    entry["endpoint-metadata"] = tmap[t]["endpoint-metadata"]
+                by_target[t] = entry
+            else:
+                missing.append(t)
+        if missing:
+            polled = await asyncio.gather(
+                *(self._check_target(session, sem, t) for t in missing)
+            )
+            by_target.update({e["target"]: e for e in polled})
+        return [by_target[t] for t in targets], batched.get("bank")
+
+    async def _finish_snapshot(
+        self, endpoints: List[Dict[str, Any]], bank, now: float
+    ) -> Dict[str, Any]:
+        """Shared snapshot tail: bank-coverage annotation, gang heartbeat
+        aggregation, cache commit. Runs under ``self._lock``."""
+        if bank is not None:
+            banked = set(bank.get("banked", []))
+            fallback = bank.get("fallback", {})
+            for entry in endpoints:
+                t = entry["target"]
+                if t in banked:
+                    entry["banked"] = True
+                elif t in fallback:
+                    entry["banked"] = False
+                    entry["bank-fallback-reason"] = fallback[t]
+                else:
+                    entry["banked"] = None  # not known to the collection
+        self._cache = {
+            "project_name": self.project,
+            "gordo-watchman-version": __version__,
+            "endpoints": endpoints,
+        }
+        if bank is not None:
+            self._cache["bank"] = bank
+        if self.gang_state_dir:
+            from gordo_components_tpu.workflow.gang_state import read_gang_states
+
+            gangs = await asyncio.get_running_loop().run_in_executor(
+                None,
+                read_gang_states,
+                self.gang_state_dir,
+                self.gang_stale_after,
+            )
+            self._cache["gangs"] = gangs
+        self._cache_time = now
+        return self._cache
 
 
 def build_watchman_app(

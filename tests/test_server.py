@@ -60,6 +60,22 @@ async def test_list_models(artifact_dir):
         assert "DiffBasedAnomalyDetector" in body["bank"]["fallback"]["machine-b"]
 
 
+async def test_metadata_all(artifact_dir):
+    """The batched control-plane endpoint: every target's health +
+    metadata (+ bank coverage) in one response, so watchman snapshots
+    cost O(1) requests instead of O(2N) per-target polls."""
+    async with make_client(artifact_dir) as client:
+        resp = await client.get("/gordo/v0/proj/metadata-all")
+        assert resp.status == 200
+        body = await resp.json()
+        assert set(body["targets"]) == {"machine-a", "machine-b"}
+        for name, entry in body["targets"].items():
+            assert entry["healthy"] is True
+            assert entry["endpoint-metadata"]["name"] == name
+        assert body["bank"]["banked"] == ["machine-a"]
+        assert "machine-b" in body["bank"]["fallback"]
+
+
 async def test_healthcheck_and_404(artifact_dir):
     async with make_client(artifact_dir) as client:
         resp = await client.get("/gordo/v0/proj/machine-a/healthcheck")

@@ -105,3 +105,114 @@ async def test_watchman_healthcheck_endpoint():
         assert "gordo-watchman-version" in await resp.json()
     finally:
         await client.close()
+
+
+def _counting_stub(n_targets, with_batched=True):
+    """Stub collection server with a per-route request counter."""
+    from aiohttp import web
+
+    counts = {"total": 0}
+    names = [f"t-{i}" for i in range(n_targets)]
+
+    @web.middleware
+    async def counter(request, handler):
+        counts["total"] += 1
+        return await handler(request)
+
+    app = web.Application(middlewares=[counter])
+
+    async def metadata_all(request):
+        return web.json_response(
+            {
+                "project": "proj",
+                "targets": {
+                    n: {"healthy": True, "endpoint-metadata": {"name": n}}
+                    for n in names
+                },
+            }
+        )
+
+    async def models(request):
+        return web.json_response({"project": "proj", "models": names})
+
+    async def healthcheck(request):
+        if request.match_info["target"] not in names:
+            raise web.HTTPNotFound()
+        return web.json_response({})
+
+    async def metadata(request):
+        t = request.match_info["target"]
+        if t not in names:
+            raise web.HTTPNotFound()
+        return web.json_response({"endpoint-metadata": {"name": t}})
+
+    if with_batched:
+        app.router.add_get("/gordo/v0/proj/metadata-all", metadata_all)
+    app.router.add_get("/gordo/v0/proj/models", models)
+    app.router.add_get("/gordo/v0/proj/{target}/healthcheck", healthcheck)
+    app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
+    return app, counts, names
+
+
+async def test_watchman_snapshot_costs_one_request():
+    """A snapshot of an N-model collection must cost O(1) HTTP requests
+    via the batched metadata-all endpoint — not O(2N) per-target polls
+    (20k requests/30s at the 10k north star)."""
+    from aiohttp.test_utils import TestServer
+
+    app, counts, names = _counting_stub(50)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        base = f"http://{server.host}:{server.port}"
+        body = await WatchmanState("proj", base).snapshot()
+    finally:
+        await server.close()
+    assert counts["total"] == 1
+    by_target = {e["target"]: e for e in body["endpoints"]}
+    assert set(by_target) == set(names)
+    for n, entry in by_target.items():
+        assert entry["healthy"] is True
+        assert entry["endpoint-metadata"]["name"] == n
+
+
+async def test_watchman_falls_back_per_target_without_batched_endpoint():
+    """Foreign servers that don't speak metadata-all (404) still get the
+    reference-style per-target polling path."""
+    from aiohttp.test_utils import TestServer
+
+    app, counts, names = _counting_stub(3, with_batched=False)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        base = f"http://{server.host}:{server.port}"
+        body = await WatchmanState("proj", base).snapshot()
+    finally:
+        await server.close()
+    by_target = {e["target"]: e for e in body["endpoints"]}
+    assert set(by_target) == set(names)
+    assert all(e["healthy"] for e in by_target.values())
+    # 1 failed metadata-all + 1 models + 2 per target
+    assert counts["total"] == 2 + 2 * len(names)
+
+
+async def test_watchman_batched_with_explicit_unknown_target():
+    """Explicit targets missing from the batched response are polled
+    individually (they may live on a foreign per-model server)."""
+    from aiohttp.test_utils import TestServer
+
+    app, counts, names = _counting_stub(2)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        base = f"http://{server.host}:{server.port}"
+        body = await WatchmanState(
+            "proj", base, targets=["t-0", "ghost"]
+        ).snapshot()
+    finally:
+        await server.close()
+    by_target = {e["target"]: e for e in body["endpoints"]}
+    assert [e["target"] for e in body["endpoints"]] == ["t-0", "ghost"]
+    assert by_target["t-0"]["healthy"] is True
+    # ghost 404s on healthcheck -> unhealthy, but the snapshot still lands
+    assert by_target["ghost"]["healthy"] is False
