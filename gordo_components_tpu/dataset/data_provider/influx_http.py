@@ -1,0 +1,88 @@
+"""Minimal InfluxDB 1.x HTTP API client, stdlib-only.
+
+Reference parity: the reference's ``InfluxDataProvider`` rides the
+``influxdb`` package's ``DataFrameClient`` (gordo_components/dataset/
+data_provider/providers.py, unverified; SURVEY.md §2 "dataset.data_provider",
+§4 dockerized-Influx integration tests). That package isn't in this image,
+so this module speaks the same wire protocol directly:
+
+- ``GET /query?db=<db>&q=<iql>`` with optional HTTP basic auth;
+- response dialect ``{"results": [{"series": [{"name", "columns",
+  "values"}], "error"?}]}`` parsed into per-measurement DataFrames indexed
+  by UTC time — the surface ``DataFrameClient.query`` exposes and the
+  provider consumes (``{measurement: DataFrame}``).
+
+Kwarg names mirror ``DataFrameClient`` (host/port/username/password/
+database/ssl) so ``_client_from_uri`` builds either interchangeably.
+"""
+
+import base64
+import json
+import logging
+import urllib.request
+from typing import Dict, Optional
+from urllib.parse import urlencode
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+
+class SimpleInfluxClient:
+    """``query(iql) -> {measurement: DataFrame}`` over the Influx 1.x HTTP
+    API. Timestamps come back RFC3339 (Influx's default JSON encoding) and
+    are parsed to a UTC DatetimeIndex named ``time``."""
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 8086,
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        database: Optional[str] = None,
+        ssl: bool = False,
+        timeout: float = 30.0,
+    ):
+        self.host = host
+        self.port = int(port)
+        self.username = username
+        self.password = password
+        self.database = database
+        self.ssl = bool(ssl)
+        self.timeout = float(timeout)
+
+    @property
+    def _base_url(self) -> str:
+        scheme = "https" if self.ssl else "http"
+        return f"{scheme}://{self.host}:{self.port}"
+
+    def query(self, q: str) -> Dict[str, pd.DataFrame]:
+        params = {"q": q}
+        if self.database:
+            params["db"] = self.database
+        req = urllib.request.Request(f"{self._base_url}/query?{urlencode(params)}")
+        if self.username is not None:
+            token = base64.b64encode(
+                f"{self.username}:{self.password or ''}".encode()
+            ).decode()
+            req.add_header("Authorization", f"Basic {token}")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = json.load(resp)
+
+        out: Dict[str, pd.DataFrame] = {}
+        for result in body.get("results", []):
+            if "error" in result:
+                # statement-level errors (bad IQL, unknown db) arrive with
+                # HTTP 200; surface them instead of returning empty frames
+                raise RuntimeError(f"InfluxDB query error: {result['error']}")
+            for series in result.get("series", []) or []:
+                cols = series.get("columns", [])
+                df = pd.DataFrame(series.get("values", []), columns=cols)
+                if "time" in cols:
+                    df["time"] = pd.to_datetime(df["time"], utc=True)
+                    df = df.set_index("time")
+                name = series.get("name", "")
+                if name in out:
+                    df = pd.concat([out[name], df])
+                out[name] = df
+        return out

@@ -158,18 +158,39 @@ class InfluxDataProvider(GordoBaseDataProvider):
     @property
     def client(self):
         if self._client is None:
+            fallback = False
             try:
-                from influxdb import DataFrameClient  # not in this image; injectable
-            except ImportError as exc:
+                from influxdb import DataFrameClient as client_cls
+            except ImportError:
+                # stdlib-only client speaking the same 1.x HTTP dialect
+                # (influx_http.py): the provider stays usable in images
+                # without the influxdb package
+                from gordo_components_tpu.dataset.data_provider.influx_http import (
+                    SimpleInfluxClient as client_cls,
+                )
+
+                fallback = True
+                logger.info(
+                    "influxdb package unavailable; using the built-in "
+                    "stdlib HTTP client"
+                )
+            try:
+                if self.uri:
+                    self._client = _client_from_uri(client_cls, self.uri)
+                else:
+                    self._client = client_cls(**self._client_kwargs)
+            except TypeError as exc:
+                if not fallback:
+                    raise
+                # a DataFrameClient-only kwarg (pool_size, proxies, ...)
+                # would surface as an opaque environment-dependent
+                # TypeError; keep the old ImportError guidance instead
                 raise ImportError(
-                    "The 'influxdb' client package is unavailable in this "
-                    "environment; pass client= to InfluxDataProvider (any "
-                    "object with .query(str) -> {measurement: DataFrame})"
+                    "The 'influxdb' client package is unavailable and the "
+                    f"built-in stdlib client rejected the config: {exc}. "
+                    "Install influxdb or pass client= to InfluxDataProvider "
+                    "(any object with .query(str) -> {measurement: DataFrame})"
                 ) from exc
-            if self.uri:
-                self._client = _client_from_uri(DataFrameClient, self.uri)
-            else:
-                self._client = DataFrameClient(**self._client_kwargs)
         return self._client
 
     def can_handle_tag(self, tag: SensorTag) -> bool:

@@ -35,6 +35,19 @@ def _collection(request: web.Request):
     return request.app["collection"]
 
 
+def _names_snapshot(collection):
+    """Sorted model names, tolerant of a concurrent ``/reload``: refresh()
+    mutates the models dict on an executor thread, and iterating a dict
+    being resized raises RuntimeError — retry past the (tiny) mutation
+    window instead of 500ing the control plane."""
+    for _ in range(8):
+        try:
+            return sorted(collection.models)
+        except RuntimeError:
+            continue
+    return sorted(collection.models)  # final attempt; let it raise
+
+
 def _get_model(request: web.Request):
     target = request.match_info["target"]
     collection = _collection(request)
@@ -77,7 +90,7 @@ def _bank_coverage(request: web.Request, names) -> Any:
 async def list_models(request: web.Request) -> web.Response:
     body = {
         "project": request.match_info["project"],
-        "models": _collection(request).names(),
+        "models": _names_snapshot(_collection(request)),
     }
     bank = _bank_coverage(request, body["models"])
     if bank is not None:
@@ -96,17 +109,22 @@ async def metadata_all(request: web.Request) -> web.Response:
     collection is loaded and servable, so ``healthy`` mirrors what
     per-target ``/healthcheck`` (200 iff present) would report."""
     collection = _collection(request)
+    names = _names_snapshot(collection)
     targets = {}
-    for name in collection.names():
+    for name in names:
         # .get(): a concurrent /reload mutates models/metadata on an
-        # executor thread, so a name can momentarily lack its metadata —
-        # skip it (the next snapshot sees the settled state) instead of
-        # 500ing the whole batched response
+        # executor thread, so a name can momentarily lack its metadata.
+        # The model is still IN the collection (per-target /healthcheck
+        # would 200), so report it healthy without metadata rather than
+        # dropping it — absence-based alerting must not fire on a reload
+        # window.
         meta = collection.metadata.get(name)
+        entry = {"healthy": True}
         if meta is not None:
-            targets[name] = {"healthy": True, "endpoint-metadata": meta}
+            entry["endpoint-metadata"] = meta
+        targets[name] = entry
     body = {"project": request.match_info["project"], "targets": targets}
-    bank = _bank_coverage(request, collection.names())
+    bank = _bank_coverage(request, names)
     if bank is not None:
         body["bank"] = bank
     return web.json_response(body)

@@ -82,13 +82,20 @@ class WatchmanState:
         serves scoring traffic). Returns None when the server doesn't
         speak it (non-200), so foreign per-model servers keep working via
         the per-target fallback."""
-        try:
+        async def get():
             async with session.get(
                 f"{self.base_url}/gordo/v0/{self.project}/metadata-all"
             ) as resp:
                 if resp.status != 200:
                     return None
-                body = await resp.json()
+                return await resp.json()
+
+        try:
+            # own short deadline: this pre-flight runs serially BEFORE the
+            # fallback, so a foreign endpoint that accepts the connection
+            # but hangs must not stall every snapshot by the full 30s
+            # client timeout
+            body = await asyncio.wait_for(get(), timeout=10.0)
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
             # ValueError covers json.JSONDecodeError: a malformed 200 must
             # fall back, not crash the snapshot
