@@ -19,6 +19,7 @@ import pandas as pd
 from gordo_components_tpu.client.io import fetch_json
 from gordo_components_tpu.dataset import get_dataset
 from gordo_components_tpu.server.utils import dict_to_frame
+from gordo_components_tpu.utils import parquet_engine_available
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +53,7 @@ class Client:
         forwarder=None,
         use_anomaly: bool = True,
         metadata_fallback_dataset: Optional[Dict[str, Any]] = None,
+        use_parquet="auto",
     ):
         self.project = project
         self.base_url = base_url or f"{scheme}://{host}:{port}"
@@ -60,17 +62,19 @@ class Client:
         self.forwarder = forwarder
         self.use_anomaly = use_anomaly
         self.metadata_fallback_dataset = metadata_fallback_dataset
+        # request-body encoding for scoring POSTs: "auto" upgrades to
+        # parquet when the server advertises it (JSON float-list
+        # encode/decode dominates at fleet-backfill scale — the reference's
+        # client used parquet for the same reason); True forces parquet,
+        # False forces JSON. A mid-run parquet rejection (foreign server)
+        # downgrades the rest of the run to JSON.
+        self.use_parquet = use_parquet
+        self._parquet_active = False
 
     # ------------------------------------------------------------------ #
 
     def _url(self, target: str, endpoint: str) -> str:
         return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
-
-    async def _get_targets(self, session) -> List[str]:
-        body = await fetch_json(
-            session, f"{self.base_url}/gordo/v0/{self.project}/models"
-        )
-        return body["models"]
 
     async def _get_metadata(self, session, target: str) -> Dict[str, Any]:
         body = await fetch_json(session, self._url(target, "metadata"))
@@ -118,8 +122,32 @@ class Client:
         timeout = aiohttp.ClientTimeout(total=600)
         sem = asyncio.Semaphore(self.parallelism)
         async with aiohttp.ClientSession(timeout=timeout) as session:
+            models_body = None
+            if targets is None or self.use_parquet == "auto":
+                try:
+                    models_body = await fetch_json(
+                        session, f"{self.base_url}/gordo/v0/{self.project}/models"
+                    )
+                except Exception:
+                    if targets is None:  # discovery is mandatory
+                        raise
+                    models_body = None  # encoding probe is best-effort
             if targets is None:
-                targets = await self._get_targets(session)
+                targets = models_body["models"]
+            if self.use_parquet == "auto":
+                self._parquet_active = parquet_engine_available() and any(
+                    "parquet" in a
+                    for a in (models_body or {}).get("accepts", [])
+                )
+            else:
+                self._parquet_active = bool(self.use_parquet)
+                if self._parquet_active and not parquet_engine_available():
+                    # forced mode fails loudly up front, not one opaque
+                    # to_parquet ImportError per chunk
+                    raise ImportError(
+                        "use_parquet=True but no parquet engine "
+                        "(pyarrow/fastparquet) is installed"
+                    )
             results = await asyncio.gather(
                 *(
                     self._predict_single(session, sem, t, start, end)
@@ -131,6 +159,21 @@ class Client:
                 if result.ok:
                     self.forwarder.forward(result)
         return list(results)
+
+    async def _post_parquet(self, session, target, endpoint, chunk: pd.DataFrame):
+        """POST one chunk as a parquet body (index rides inside the file,
+        so timestamps round-trip without the JSON string lists)."""
+        import io
+
+        buf = io.BytesIO()
+        chunk.to_parquet(buf)
+        return await fetch_json(
+            session,
+            self._url(target, endpoint),
+            method="POST",
+            data=buf.getvalue(),
+            headers={"Content-Type": "application/x-parquet"},
+        )
 
     async def _predict_single(
         self, session, sem, target: str, start, end
@@ -151,11 +194,31 @@ class Client:
         errors: List[str] = []
 
         async def post_chunk(chunk: pd.DataFrame):
-            payload = {
-                "X": chunk.values.tolist(),
-                "index": [str(i) for i in chunk.index],
-            }
             async with sem:
+                parquet_exc = None
+                if self._parquet_active:
+                    try:
+                        return await self._post_parquet(
+                            session, target, endpoint, chunk
+                        )
+                    except ValueError as exc:
+                        # 4xx on the parquet body. Ambiguous: the server
+                        # may reject the ENCODING (foreign pod, no parse
+                        # engine) or this chunk may hit a genuine model
+                        # error that would 400 under any encoding. The
+                        # JSON re-post below disambiguates; forced mode
+                        # never downgrades (documented contract).
+                        if self.use_parquet is True:
+                            errors.append(f"chunk {chunk.index[0]}: {exc}")
+                            return None
+                        parquet_exc = exc
+                    except Exception as exc:
+                        errors.append(f"chunk {chunk.index[0]}: {exc}")
+                        return None
+                payload = {
+                    "X": chunk.values.tolist(),
+                    "index": [str(i) for i in chunk.index],
+                }
                 try:
                     body = await fetch_json(
                         session,
@@ -166,6 +229,16 @@ class Client:
                 except Exception as exc:
                     errors.append(f"chunk {chunk.index[0]}: {exc}")
                     return None
+                if parquet_exc is not None:
+                    # JSON succeeded where parquet 4xx'd: an encoding
+                    # problem, not a model error — downgrade the rest of
+                    # the run (a model error would have failed both and
+                    # must NOT cost the whole fleet its parquet win)
+                    logger.warning(
+                        "parquet body rejected (%s) but JSON succeeded; "
+                        "downgrading run to JSON", parquet_exc,
+                    )
+                    self._parquet_active = False
                 return body
 
         chunks = [

@@ -24,15 +24,23 @@ async def fetch_json(
     *,
     method: str = "GET",
     json_payload: Optional[Dict[str, Any]] = None,
+    data: Optional[bytes] = None,
+    headers: Optional[Dict[str, str]] = None,
     retries: int = 3,
     backoff: float = 0.5,
 ) -> Dict[str, Any]:
     """GET/POST returning parsed JSON, with bounded retry on transient
-    failures; 4xx (except 408/429) are not retried."""
+    failures; 4xx (except 408/429) are not retried. ``data`` posts a raw
+    body (e.g. parquet bytes) with ``headers`` carrying its content type;
+    mutually exclusive with ``json_payload``."""
+    if json_payload is not None and data is not None:
+        raise ValueError("pass json_payload or data, not both")
     last_exc: Optional[Exception] = None
     for attempt in range(retries):
         try:
-            async with session.request(method, url, json=json_payload) as resp:
+            async with session.request(
+                method, url, json=json_payload, data=data, headers=headers
+            ) as resp:
                 if resp.status == 422:
                     raise HttpUnprocessableEntity(await resp.text())
                 if resp.status in (408, 429) or resp.status >= 500:

@@ -33,12 +33,35 @@ class ModelCollection:
     def __init__(self, root: str, target_name: Optional[str] = None):
         self.root = root
         self.target_name = target_name
-        self.models: Dict[str, Any] = {}
-        self.metadata: Dict[str, Dict] = {}
+        # (models, metadata) published together as ONE tuple: refresh()
+        # builds fresh dicts off to the side and swaps them in with a
+        # single (GIL-atomic) assignment, so readers on other threads
+        # never see a half-mutated collection — published dicts are never
+        # mutated afterwards. Read both sides through snapshot() when
+        # cross-dict consistency matters.
+        self._state: tuple = ({}, {})
         self._mtimes: Dict[str, float] = {}
         self.refresh()
         if not self.models:
             raise FileNotFoundError(f"No model artifacts found under {root!r}")
+
+    @property
+    def models(self) -> Dict[str, Any]:
+        return self._state[0]
+
+    @property
+    def metadata(self) -> Dict[str, Dict]:
+        return self._state[1]
+
+    def snapshot(self) -> tuple:
+        """One consistent (models, metadata) pair."""
+        return self._state
+
+    def entry(self, name: str):
+        """(model, metadata) read from ONE state snapshot — the two-dict
+        lookup a concurrent refresh could otherwise straddle."""
+        models, metadata = self._state
+        return models[name], metadata.get(name, {})
 
     def _scan(self) -> Dict[str, str]:
         """name -> artifact dir for the current on-disk state."""
@@ -58,42 +81,47 @@ class ModelCollection:
 
     def refresh(self) -> Dict[str, list]:
         """Incremental rescan. Returns {"added": [...], "updated": [...],
-        "removed": [...]} by model name."""
+        "removed": [...]} by model name. Changes are staged on copies and
+        published atomically (see ``_state``); a load failure mid-refresh
+        leaves the previous consistent state serving."""
         on_disk = self._scan()
+        models, metadata = dict(self.models), dict(self.metadata)
         added, updated, removed = [], [], []
-        for name in list(self.models):
+        for name in list(models):
             if name not in on_disk:
                 removed.append(name)
-                del self.models[name]
-                del self.metadata[name]
+                del models[name]
+                metadata.pop(name, None)
                 self._mtimes.pop(name, None)
         for name, path in on_disk.items():
             try:
                 mtime = os.path.getmtime(os.path.join(path, "model.pkl"))
             except OSError:
                 continue
-            if name not in self.models:
-                self._load_one(name, path)
+            if name not in models:
+                self._load_one(models, metadata, name, path)
                 self._mtimes[name] = mtime
                 added.append(name)
             elif mtime != self._mtimes.get(name):
-                self._load_one(name, path)
+                self._load_one(models, metadata, name, path)
                 self._mtimes[name] = mtime
                 updated.append(name)
+        self._state = (models, metadata)  # atomic publish
         if added or updated or removed:
             logger.info(
                 "Collection refresh: +%d ~%d -%d (now %d models)",
-                len(added), len(updated), len(removed), len(self.models),
+                len(added), len(updated), len(removed), len(models),
             )
         return {"added": added, "updated": updated, "removed": removed}
 
-    def _load_one(self, name: str, path: str) -> None:
+    @staticmethod
+    def _load_one(models: Dict, metadata: Dict, name: str, path: str) -> None:
         logger.info("Loading model %r from %s", name, path)
-        self.models[name] = serializer.load(path)
+        models[name] = serializer.load(path)
         meta = serializer.load_metadata(path)
         # serve the artifact's recorded name if present
         meta.setdefault("name", name)
-        self.metadata[name] = meta
+        metadata[name] = meta
 
     def __contains__(self, name: str) -> bool:
         return name in self.models

@@ -25,6 +25,7 @@ from aiohttp import web
 
 from gordo_components_tpu import __version__, serializer
 from gordo_components_tpu.server.utils import extract_x_y, frame_to_dict
+from gordo_components_tpu.utils import parquet_engine_available
 
 logger = logging.getLogger(__name__)
 
@@ -35,28 +36,22 @@ def _collection(request: web.Request):
     return request.app["collection"]
 
 
-def _names_snapshot(collection):
-    """Sorted model names, tolerant of a concurrent ``/reload``: refresh()
-    mutates the models dict on an executor thread, and iterating a dict
-    being resized raises RuntimeError — retry past the (tiny) mutation
-    window instead of 500ing the control plane."""
-    for _ in range(8):
-        try:
-            return sorted(collection.models)
-        except RuntimeError:
-            continue
-    return sorted(collection.models)  # final attempt; let it raise
+_PARQUET_OK = parquet_engine_available()
 
 
 def _get_model(request: web.Request):
     target = request.match_info["target"]
     collection = _collection(request)
-    if target not in collection:
+    try:
+        # one-state read: a concurrent /reload swapping the collection
+        # must not let the existence check and the metadata lookup see
+        # different states
+        return collection.entry(target)
+    except KeyError:
         raise web.HTTPNotFound(
             text=json.dumps({"error": f"No such model: {target}"}),
             content_type="application/json",
         )
-    return collection[target], collection.metadata[target]
 
 
 def _bank_engine(request: web.Request):
@@ -90,7 +85,14 @@ def _bank_coverage(request: web.Request, names) -> Any:
 async def list_models(request: web.Request) -> web.Response:
     body = {
         "project": request.match_info["project"],
-        "models": _names_snapshot(_collection(request)),
+        "models": _collection(request).names(),
+        # advertised request encodings: the bulk client upgrades its POST
+        # bodies to parquet when it sees this (client/client.py) — JSON
+        # float-list encode/decode dominates at fleet-backfill scale.
+        # Parquet only when a parse engine is actually importable, or
+        # every advertised-then-posted body would 500.
+        "accepts": ["application/json"]
+        + (["application/x-parquet"] if _PARQUET_OK else []),
     }
     bank = _bank_coverage(request, body["models"])
     if bank is not None:
@@ -108,18 +110,15 @@ async def metadata_all(request: web.Request) -> web.Response:
     the same process that serves scoring traffic. A model present in the
     collection is loaded and servable, so ``healthy`` mirrors what
     per-target ``/healthcheck`` (200 iff present) would report."""
-    collection = _collection(request)
-    names = _names_snapshot(collection)
+    # ONE consistent (models, metadata) state: a concurrent /reload swaps
+    # the collection atomically, so reading both sides from one snapshot
+    # can neither 500 nor drop a target mid-reload
+    models, metadata = _collection(request).snapshot()
+    names = sorted(models)
     targets = {}
     for name in names:
-        # .get(): a concurrent /reload mutates models/metadata on an
-        # executor thread, so a name can momentarily lack its metadata.
-        # The model is still IN the collection (per-target /healthcheck
-        # would 200), so report it healthy without metadata rather than
-        # dropping it — absence-based alerting must not fire on a reload
-        # window.
-        meta = collection.metadata.get(name)
         entry = {"healthy": True}
+        meta = metadata.get(name)
         if meta is not None:
             entry["endpoint-metadata"] = meta
         targets[name] = entry
@@ -215,6 +214,15 @@ async def download_model(request: web.Request) -> web.Response:
 async def _parse_request(request: web.Request):
     content_type = request.content_type or "application/json"
     if "parquet" in content_type:
+        if not _PARQUET_OK:
+            # a clean 415 (instead of an ImportError 500) lets the bulk
+            # client downgrade the run to JSON
+            raise web.HTTPUnsupportedMediaType(
+                text=json.dumps(
+                    {"error": "no parquet engine installed on this server"}
+                ),
+                content_type="application/json",
+            )
         raw = await request.read()
         return extract_x_y(None, raw, content_type)
     try:

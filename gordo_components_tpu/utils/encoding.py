@@ -1,0 +1,27 @@
+"""Wire-encoding capability probes shared by the server and bulk client.
+
+The scoring POST bodies can ride parquet instead of JSON float lists
+(SURVEY.md §2 "server"/"client": the reference supported both and its bulk
+client used parquet because JSON encode/decode dominates at backfill
+scale). pandas needs a parquet engine for that; this probe is how the
+server decides what to advertise and the client decides what to send.
+"""
+
+import functools
+
+
+@functools.cache
+def parquet_engine_available() -> bool:
+    """True iff pandas can (de)serialize parquet here (pyarrow or
+    fastparquet importable)."""
+    try:
+        import pyarrow  # noqa: F401
+
+        return True
+    except ImportError:
+        try:
+            import fastparquet  # noqa: F401
+
+            return True
+        except ImportError:
+            return False

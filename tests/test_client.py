@@ -126,3 +126,76 @@ def test_influx_forwarder_points():
     p = fake.points[0]
     assert p["tags"] == {"machine": "machine-a", "field": "total-anomaly"}
     assert p["fields"] == {"value": 1.0}
+
+
+async def test_client_parquet_auto_equals_json(collection_dir, live_server):
+    """The collection server advertises parquet, so auto mode upgrades the
+    POST bodies; scored frames must be identical to the JSON encoding."""
+    start = pd.Timestamp("2017-12-25 06:00:00Z")
+    end = pd.Timestamp("2017-12-25 12:00:00Z")
+    async with live_server(collection_dir) as base_url:
+        auto = Client("proj", base_url=base_url, batch_size=10)
+        res_pq = await auto.predict_async(start, end)
+        assert auto._parquet_active is True  # upgrade actually happened
+        plain = Client("proj", base_url=base_url, batch_size=10, use_parquet=False)
+        res_js = await plain.predict_async(start, end)
+    assert plain._parquet_active is False
+    assert res_pq[0].ok and res_js[0].ok
+    pd.testing.assert_frame_equal(res_pq[0].predictions, res_js[0].predictions)
+
+
+async def test_client_parquet_downgrades_when_rejected():
+    """A server that advertises parquet but rejects the bodies (foreign
+    implementation) must not fail the run: the client re-posts as JSON
+    and downgrades the rest of the run."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    seen = {"parquet": 0, "json": 0}
+
+    async def models(request):
+        return web.json_response(
+            {"models": ["m-1"], "accepts": ["application/x-parquet"]}
+        )
+
+    async def metadata(request):
+        return web.json_response({"endpoint-metadata": {}})
+
+    async def predict(request):
+        if "parquet" in (request.content_type or ""):
+            seen["parquet"] += 1
+            raise web.HTTPBadRequest(text='{"error": "no parquet here"}')
+        seen["json"] += 1
+        body = await request.json()
+        return web.json_response(
+            {"data": [[0.0] * 3] * len(body["X"]), "index": body["index"]}
+        )
+
+    app = web.Application()
+    app.router.add_get("/gordo/v0/proj/models", models)
+    app.router.add_get("/gordo/v0/proj/m-1/metadata", metadata)
+    app.router.add_post("/gordo/v0/proj/m-1/anomaly/prediction", predict)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        client = Client(
+            "proj",
+            base_url=f"http://{server.host}:{server.port}",
+            batch_size=10,
+            metadata_fallback_dataset={
+                "type": "RandomDataset",
+                "tag_list": ["a", "b", "c"],
+            },
+        )
+        results = await client.predict_async(
+            pd.Timestamp("2020-01-01 00:00:00Z"),
+            pd.Timestamp("2020-01-01 06:00:00Z"),
+        )
+    finally:
+        await server.close()
+    assert results[0].ok, results[0].error_messages
+    # in-flight chunks may each probe parquet before the first rejection
+    # lands, but every one must re-post as JSON in the same call
+    assert 1 <= seen["parquet"] <= seen["json"]
+    assert seen["json"] == 4  # 36 rows / batch 10 -> all 4 chunks scored
+    assert client._parquet_active is False
