@@ -8,13 +8,20 @@ available accelerator, covering every BASELINE.md config:
   4. conv1d / variational autoencoder variants -> conv_/vae_models_per_hour
   5. streaming HBM bank serving                -> bank_serving_samples_per_sec
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+Output contract: the LAST stdout line is a compact (<=1 KB) headline JSON
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "platform": ..., "device_kind": ..., "mfu": ..., "errors": {...}}
+that survives tail-only capture (the round-2 artifact lost its headline to
+a single giant line). Full per-metric detail is written to
+``BENCH_DETAIL.json`` next to this file and printed on the penultimate
+``DETAIL`` stdout line.
 
 Robustness contract (the driver runs this unattended on real hardware):
-- the default backend is probed in a SUBPROCESS with a timeout first — a
-  wedged TPU plugin can hang in a retry loop rather than error, and the
-  probe converts that hang into a clean CPU fallback;
+- the backend is probed in SUBPROCESSES with hard timeouts and exponential
+  backoff over a ~10 min budget, in two flavors (default resolution and an
+  in-process 'tpu' pin) — a wedged TPU plugin can hang in a retry loop
+  rather than error, and the probe converts that hang into a clean CPU
+  fallback with every attempt's failure mode recorded;
 - every metric runs isolated: one failing metric reports into ``errors``
   without zeroing the others;
 - any outcome, including total failure, still prints exactly one JSON line.
@@ -50,21 +57,31 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# HBM bandwidth peak per chip, bytes/s (public spec sheets). For the
+# 417-param reference-scale models the chip is bandwidth-bound by design,
+# so achieved-bytes/s vs THIS peak — not MFU — is the honest efficiency
+# number (VERDICT r2 weak #6).
+PEAK_HBM_BYTES = {
+    "TPU v4": 1.2288e12,
+    "TPU v5 lite": 8.19e11,  # v5e
+    "TPU v5e": 8.19e11,
+    "TPU v5p": 2.765e12,
+    "TPU v6 lite": 1.64e12,  # v6e / Trillium
+    "TPU v6e": 1.64e12,
+}
 
-def probe_backend(timeout: float = 180.0, attempts: int = 2):
-    """Probe the default JAX backend in a subprocess.
 
-    A wedged accelerator plugin can HANG rather than error — observed in two
-    distinct layers across rounds: (a) backend INIT blocks in a sleep/retry
-    loop, and (b) init succeeds (devices list fine) but the first
-    device-transfer/execution blocks forever in a socket recv. No in-process
-    try/except can recover from either, so the probe runs out-of-process with
-    a hard timeout AND must exercise the full execute+fetch path, not just
-    `jax.devices()`. Returns (platform, device_kind, n_devices) or
-    (None, None, 0).
-    """
+def _probe_once(pin, timeout):
+    """One probe attempt: run the full host->device->compute->fetch round
+    trip in a subprocess under a hard timeout. Returns
+    (platform, kind, n) on success, or (None, None, 0, failure-string)."""
+    pin_line = (
+        f"jax.config.update('jax_platforms', {pin!r}); " if pin else ""
+    )
     code = (
-        "import jax, jax.numpy as jnp; d = jax.devices(); "
+        "import jax, jax.numpy as jnp; "
+        + pin_line
+        + "d = jax.devices(); "
         # full data path: host->device transfer, XLA compile, MXU execute,
         # device->host fetch. A tunnel that only answers control-plane RPCs
         # (device listing) but wedges on the data plane must fail this.
@@ -73,31 +90,97 @@ def probe_backend(timeout: float = 180.0, attempts: int = 2):
         "assert s == 128.0 * 128 * 128, s; "
         "print(d[0].platform); print(d[0].device_kind); print(len(d))"
     )
-    for attempt in range(attempts):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=timeout,
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, None, 0, f"timeout after {timeout:.0f}s (wedged data plane?)"
+    if out.returncode == 0:
+        # scan from the end for the 3-line record: init banners may
+        # precede it and shutdown/atexit prints may follow it
+        lines = out.stdout.strip().splitlines()
+        for i in range(len(lines) - 1, 1, -1):
+            try:
+                return lines[i - 2], lines[i - 1], int(lines[i]), None
+            except ValueError:
+                continue
+    tail = (out.stderr or out.stdout or "").strip().splitlines()
+    return None, None, 0, f"rc={out.returncode}: {' | '.join(tail[-2:])[:200]}"
+
+
+def probe_backend(budget: float = 600.0, attempt_timeout: float = 180.0):
+    """Stubbornly probe for an accelerator backend (VERDICT r2 next #1b).
+
+    A wedged accelerator plugin can HANG rather than error — observed in
+    two distinct layers across rounds: (a) backend INIT blocks in a
+    sleep/retry loop, and (b) init succeeds (devices list fine) but the
+    first device transfer blocks forever in a socket recv. No in-process
+    try/except recovers from either, so every attempt runs out-of-process
+    with a hard timeout, and a tunnel that wedges transiently gets retried
+    with exponential backoff until ``budget`` is spent.
+
+    Two flavors per round: the DEFAULT backend resolution, and an
+    in-process ``jax_platforms='tpu'`` pin — the env-var pin is the
+    variant known to hang on this machine, so the pin always happens
+    inside the child via jax.config.
+
+    Returns (platform, device_kind, n_devices, attempts) where attempts is
+    the per-attempt failure log for the bench artifact; (None, None, 0,
+    attempts) when no accelerator answered within budget.
+    """
+    flavors = (("default", None), ("tpu-pin", "tpu"))
+    attempts = []
+    start = time.time()
+    backoff = 5.0
+    cpu_rounds = 0
+    while True:
+        default_cpu = False
+        for name, pin in flavors:
+            remaining = budget - (time.time() - start)
+            if remaining <= 5:
+                return None, None, 0, attempts
+            t0 = time.time()
+            platform, kind, n, err = _probe_once(
+                pin, min(attempt_timeout, remaining)
             )
-        except subprocess.TimeoutExpired:
-            print(
-                f"# backend probe timed out (attempt {attempt + 1})",
-                file=sys.stderr,
-            )
-            continue
-        if out.returncode == 0:
-            # scan from the end for the 3-line record: init banners may
-            # precede it and shutdown/atexit prints may follow it
-            lines = out.stdout.strip().splitlines()
-            for i in range(len(lines) - 1, 1, -1):
-                try:
-                    return lines[i - 2], lines[i - 1], int(lines[i])
-                except ValueError:
-                    continue
-        time.sleep(5)
-    return None, None, 0
+            rec = {
+                "flavor": name,
+                "seconds": round(time.time() - t0, 1),
+            }
+            if platform is not None and platform != "cpu":
+                rec["outcome"] = f"ok: {platform}/{kind} x{n}"
+                attempts.append(rec)
+                return platform, kind, n, attempts
+            rec["outcome"] = err or f"cpu-only ({platform})"
+            attempts.append(rec)
+            if name == "default" and platform == "cpu":
+                default_cpu = True
+        if default_cpu:
+            # the default backend resolved to CPU — but a TRANSIENTLY
+            # broken TPU plugin makes JAX fall back to CPU silently, so
+            # one cheap cpu-resolution must not end the stubborn budget.
+            # Three consecutive such rounds (with backoff between, and the
+            # tpu-pin flavor failing each time too) is treated as a
+            # genuinely accelerator-less machine.
+            cpu_rounds += 1
+            if cpu_rounds >= 3:
+                return "cpu", "cpu", 1, attempts
+        else:
+            cpu_rounds = 0
+        remaining = budget - (time.time() - start)
+        if remaining <= backoff:
+            return None, None, 0, attempts
+        print(
+            f"# no accelerator yet ({len(attempts)} attempts); retrying in "
+            f"{backoff:.0f}s",
+            file=sys.stderr,
+        )
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 60.0)
 
 
 def _synth_fleet(n_models: int, rows: int, n_features: int):
@@ -126,11 +209,30 @@ def _count_params(model_type: str, kind: str, n_features: int, sample_shape, **k
     return int(sum(np.prod(l.shape) for l in jax.tree.leaves(params)))
 
 
+def _hbm_traffic_model(params, padded_rows, n_features, epochs, n_models,
+                       batch_size, dtype_bytes=2):
+    """Estimated LOWER-BOUND HBM bytes moved by one fleet fit.
+
+    Per member-epoch: the data block read once (padded_rows x f), and per
+    batch step the param/optimizer working set — read params + grads
+    written/read + adam m/v read+written + params written ≈ 7 accesses of
+    the param block (f32 opt state: 4 bytes). Activations are assumed
+    fused/register-resident (XLA fuses the tiny dense stacks), so real
+    traffic is strictly higher; the estimate still bounds how far from
+    the bandwidth roof the engine runs.
+    """
+    n_batches = -(-padded_rows // batch_size)
+    data = padded_rows * n_features * dtype_bytes
+    state = 7 * params * 4 * n_batches
+    return float((data + state) * epochs * n_models)
+
+
 def bench_fleet(
     n_models=1024, rows=1440, n_features=10, epochs=5, batch_size=128,
     host_sync_every=5,
 ):
-    """Config 3 — many-model fleet training: models/hour/chip + FLOP/s.
+    """Config 3 — many-model fleet training: models/hour/chip + FLOP/s +
+    estimated HBM bytes/s (the honest roof for tiny models).
     ``host_sync_every`` is the on-device chunk size; with the defaults
     (epochs=5, chunk=5) the whole epoch budget is one dispatch."""
     import jax
@@ -169,16 +271,59 @@ def bench_fleet(
     padded_rows = buckets[0]["padded_rows"] if buckets else -(-rows // batch_size) * batch_size
     train_flops = 6.0 * params * padded_rows * epochs * n_models
     vmap_width = buckets[0]["n_members"] if buckets else n_models
+    hbm_bytes = _hbm_traffic_model(
+        params, padded_rows, n_features, epochs, n_models, batch_size
+    )
     return {
         "fleet_models_per_hour_per_chip": round(models_per_hour_per_chip, 1),
         "fleet_wall_seconds": round(elapsed, 2),
         "model_params": params,
         "train_flops_total": train_flops,
         "achieved_flops_per_sec": round(train_flops / elapsed / n_chips, 1),
+        "hbm_bytes_model_total": hbm_bytes,
+        "achieved_hbm_bytes_per_sec": round(hbm_bytes / elapsed / n_chips, 1),
         "vmap_width": int(vmap_width),
         "fleet_config": (
             f"{n_models} models x {rows} rows x {n_features} tags, "
             f"hourglass AE, {epochs} epochs, bf16, chunk={host_sync_every}"
+        ),
+    }
+
+
+def bench_width_sweep(widths=(256, 1024, 2048, 4096), rows=720, n_features=10,
+                      epochs=3, batch_size=128):
+    """vmap-width -> throughput curve (VERDICT r2 weak #6): "width is the
+    lever" as a measurement, not an assertion. Reports models/hour/chip at
+    each width plus where the curve knees (last width whose per-model rate
+    still improved >10%)."""
+    import jax
+
+    from gordo_components_tpu.parallel import FleetTrainer
+
+    n_chips = len(jax.devices())
+    config = dict(
+        kind="feedforward_hourglass", epochs=epochs, batch_size=batch_size,
+        compute_dtype="bfloat16", host_sync_every=epochs,
+    )
+    curve = {}
+    prev_rate = None
+    knee = widths[0]
+    for width in widths:
+        members = _synth_fleet(width, rows, n_features)
+        FleetTrainer(**config).fit(members)  # per-width compile warmup
+        t0 = time.time()
+        FleetTrainer(**config).fit(members)
+        rate = width / (time.time() - t0) * 3600 / n_chips
+        curve[str(width)] = round(rate, 1)
+        if prev_rate is not None and rate > prev_rate * 1.1:
+            knee = width
+        prev_rate = rate
+    return {
+        "width_sweep_models_per_hour": curve,
+        "width_sweep_knee": int(knee),
+        "width_sweep_config": (
+            f"{rows} rows x {n_features} tags, hourglass AE, {epochs} "
+            f"epochs, bf16"
         ),
     }
 
@@ -405,48 +550,162 @@ def bench_server_scoring(n_features=10, batch=4096, iters=20):
     return {"server_recon_samples_per_sec": round(batch * iters / elapsed, 1)}
 
 
-def bench_host_pipeline(n_members=32, n_tags=10, days=30):
-    """Host-side staging throughput: members/sec through the full
-    provider->resample->join->dropna dataset path (SURVEY.md §7 hard part
-    2 — one process feeds the whole gang, so staging rate bounds fleet
-    build throughput together with the device step)."""
-    from gordo_components_tpu.dataset.datasets import TimeSeriesDataset
-    from gordo_components_tpu.dataset.data_provider.providers import (
-        RandomDataProvider,
+def bench_host_pipeline(n_members=1000, n_tags=10, days=30):
+    """Host-side staging throughput at fleet scale: members/sec through
+    the full provider->resample->join->dropna path via the SAME
+    stage_members engine a gang build uses (SURVEY.md §7 hard part 2 —
+    one process feeds the whole gang, so staging rate bounds fleet build
+    throughput together with the device step). Measures the sequential
+    baseline, the thread engine, and — on multi-core hosts — the spawned
+    process pool."""
+    import os
+
+    from gordo_components_tpu.utils.staging import (
+        load_worker_count,
+        stage_members,
     )
 
-    def stage(i):
-        ds = TimeSeriesDataset(
-            train_start_date="2020-01-01",
-            train_end_date=f"2020-01-{days + 1:02d}",
-            tag_list=[f"bench-{i}-{j}" for j in range(n_tags)],
-            data_provider=RandomDataProvider(),
-        )
-        X, _ = ds.get_data()
-        return len(X)
+    def configs(n, salt):
+        return [
+            {
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01",
+                "train_end_date": f"2020-01-{days + 1:02d}",
+                "tag_list": [f"bench-{salt}-{i}-{j}" for j in range(n_tags)],
+            }
+            for i in range(n)
+        ]
 
-    stage(0)  # warm imports
-    t0 = time.time()
-    rows = sum(stage(i) for i in range(n_members))
-    seq_el = time.time() - t0
-
-    import concurrent.futures
-
-    # the same sizing rule fleet_build's member-loading pool uses, so the
-    # threaded figure predicts what a fleet build actually achieves
-    from gordo_components_tpu.utils.staging import load_worker_count
-
+    stage_members(configs(1, "warm"), workers=1)  # warm imports
     workers = load_worker_count(n_members)
+    out = {}
+
+    # sequential baseline on a smaller probe (the engines below cover the
+    # full member count; a second full sequential pass would double the
+    # metric's wall time for no information)
+    n_probe = max(8, n_members // 8)
     t0 = time.time()
-    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
-        sum(pool.map(stage, range(n_members)))
-    par_el = time.time() - t0
-    return {
-        "host_staging_members_per_sec": round(n_members / seq_el, 2),
-        "host_staging_members_per_sec_threaded": round(n_members / par_el, 2),
-        "host_staging_rows_per_member": rows // n_members,
-        "host_staging_threads": workers,
-    }
+    loaded = stage_members(configs(n_probe, "seq"), workers=1)
+    seq_el = time.time() - t0
+    rows = sum(len(X) for X, _ in loaded)
+    out["host_staging_members_per_sec"] = round(n_probe / seq_el, 2)
+    out["host_staging_rows_per_member"] = rows // n_probe
+
+    t0 = time.time()
+    stage_members(configs(n_members, "thr"), workers=workers, mode="thread")
+    out["host_staging_members_per_sec_threaded"] = round(
+        n_members / (time.time() - t0), 2
+    )
+    out["host_staging_workers"] = workers
+    out["host_staging_members"] = n_members
+
+    if (os.cpu_count() or 1) > 1:
+        t0 = time.time()
+        stage_members(
+            configs(n_members, "proc"), workers=workers, mode="process"
+        )
+        out["host_staging_members_per_sec_process"] = round(
+            n_members / (time.time() - t0), 2
+        )
+    else:
+        # single-core host: spawned workers would only time-slice; record
+        # why the number is absent rather than publishing a bogus one
+        out["host_staging_process_skipped"] = "single-core host"
+    return out
+
+
+def bench_client_bulk(n_models=16, rows=3000, batch_size=500):
+    """Bulk-client throughput through the real HTTP path (VERDICT r2 weak
+    #7): rows/sec scoring a collection with JSON bodies vs parquet
+    bodies, same models, same server."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    import pandas as pd
+
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.client import Client
+    from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+
+    rng = np.random.RandomState(0)
+    root = tempfile.mkdtemp(prefix="bench-client-")
+    try:
+        X = rng.rand(512, 10).astype("float32")
+        for i in range(n_models):
+            det = DiffBasedAnomalyDetector(
+                base_estimator=AutoEncoder(epochs=1, batch_size=256)
+            )
+            det.fit(X + 0.01 * i)
+            serializer.dump(
+                det,
+                f"{root}/bench-m{i}",
+                metadata={"name": f"bench-m{i}"},
+            )
+
+        async def run():
+            from aiohttp.test_utils import TestServer
+
+            from gordo_components_tpu.server import build_app
+
+            server = TestServer(build_app(root))
+            await server.start_server()
+            try:
+                base = f"http://{server.host}:{server.port}"
+                # the time range sets the scored row count: RandomDataset
+                # fallback at 1min resolution -> rows minutes
+                start = pd.Timestamp("2020-01-01T00:00:00Z")
+                end = start + pd.Timedelta(minutes=rows)
+                fallback = {
+                    "type": "RandomDataset",
+                    "tag_list": [f"t-{j}" for j in range(10)],
+                    "resolution": "1min",
+                }
+                from gordo_components_tpu.utils import parquet_engine_available
+
+                encodings = [("json", False)]
+                if parquet_engine_available():
+                    encodings.append(("parquet", True))
+                rates = {}
+                for label, use_parquet in encodings:
+                    client = Client(
+                        "proj", base_url=base, batch_size=batch_size,
+                        use_parquet=use_parquet,
+                        metadata_fallback_dataset=fallback,
+                    )
+                    t0 = time.time()
+                    results = await client.predict_async(start, end)
+                    el = time.time() - t0
+                    scored = sum(
+                        len(r.predictions)
+                        for r in results
+                        if r.predictions is not None
+                    )
+                    ok = sum(r.ok for r in results)
+                    assert ok == n_models, (label, ok)
+                    rates[label] = scored / el
+                return rates
+            finally:
+                await server.close()
+
+        rates = asyncio.run(run())
+        out = {
+            "client_bulk_rows_per_sec_json": round(rates["json"], 1),
+            "client_bulk_config": (
+                f"{n_models} models x {rows} rows, batch {batch_size}"
+            ),
+        }
+        if "parquet" in rates:
+            out["client_bulk_rows_per_sec_parquet"] = round(rates["parquet"], 1)
+            out["client_parquet_vs_json"] = round(
+                rates["parquet"] / rates["json"], 2
+            )
+        else:
+            # the JSON figure still reports; the absent leg is explained
+            out["client_bulk_parquet_skipped"] = "no parquet engine installed"
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 _FLEET_FAMILIES = {
@@ -534,6 +793,7 @@ bench_vae_fleet = _family_fleet_metric("vae")
 
 METRICS = (
     ("fleet", bench_fleet),
+    ("width_sweep", bench_width_sweep),
     ("lstm_fleet", bench_lstm_fleet),
     ("conv_fleet", bench_conv_fleet),
     ("vae_fleet", bench_vae_fleet),
@@ -544,6 +804,7 @@ METRICS = (
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
+    ("client_bulk", bench_client_bulk),
 )
 
 # The CPU fallback exists to keep the JSON line complete when the TPU is
@@ -553,6 +814,7 @@ METRICS = (
 # metric's own config/size fields record what actually ran.
 CPU_KWARGS = {
     "fleet": dict(n_models=256, epochs=3),
+    "width_sweep": dict(widths=(64, 256), rows=256, epochs=2),
     "lstm_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
     "conv_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
     "vae_fleet": dict(n_models=32, rows=256, epochs=2),
@@ -561,6 +823,8 @@ CPU_KWARGS = {
     "checkpoint": dict(n_models=64, epochs=3),
     "bank_serving": dict(n_models=16, iters=5),
     "bank_sequence": dict(n_models=8, iters=5),
+    "host_pipeline": dict(n_members=64),
+    "client_bulk": dict(n_models=4, rows=1000),
 }
 
 # A metric that produces no result for this long is declared wedged: the
@@ -737,7 +1001,9 @@ def main():
     detail = {}
     errors = {}
 
-    platform, device_kind, n_devices = probe_backend()
+    budget = float(os.environ.get("GRAFT_BENCH_PROBE_BUDGET_S", 600))
+    platform, device_kind, n_devices, probe_attempts = probe_backend(budget)
+    detail["backend_probe"] = probe_attempts
     env_platform = None
     if platform == "cpu":
         # CPU-only machine: pass the platform down so the child applies
@@ -746,9 +1012,13 @@ def main():
         # one core)
         env_platform = "cpu"
     if platform is None:
-        # default backend unusable (hang or error): fall back to CPU so the
-        # run still yields numbers, with the platform recorded honestly
-        errors["backend"] = "default backend probe failed; CPU fallback"
+        # no accelerator answered within the probe budget (hang or
+        # error): fall back to CPU so the run still yields numbers, with
+        # the platform and every probe attempt recorded honestly
+        errors["backend"] = (
+            f"no accelerator after {len(probe_attempts)} probe attempts "
+            f"({budget:.0f}s budget); CPU fallback"
+        )
         env_platform = "cpu"
         platform, device_kind, n_devices = "cpu", "cpu", 1
 
@@ -789,21 +1059,67 @@ def main():
     if peak and detail.get("achieved_flops_per_sec") and "fleet" not in fell_back:
         detail["mfu"] = round(detail["achieved_flops_per_sec"] / peak, 6)
         detail["peak_bf16_flops_per_sec"] = peak
+    # bandwidth roofline: for 417-param models HBM bytes/s vs peak is the
+    # efficiency number that matters (the traffic model is a documented
+    # lower bound, so the fraction is optimistic-by-construction)
+    hbm_peak = PEAK_HBM_BYTES.get(device_kind or "")
+    if (
+        hbm_peak
+        and detail.get("achieved_hbm_bytes_per_sec")
+        and "fleet" not in fell_back
+    ):
+        detail["peak_hbm_bytes_per_sec"] = hbm_peak
+        detail["hbm_fraction_of_peak"] = round(
+            detail["achieved_hbm_bytes_per_sec"] / hbm_peak, 4
+        )
 
-    result = {
+    vs_baseline = (
+        round(fleet_rate / seq_rate, 2)
+        if fleet_rate and seq_rate and same_platform
+        else None
+    )
+
+    # ---- output contract (VERDICT r2 next #1a): the driver tails stdout,
+    # so the LAST line must be a compact headline that survives tail
+    # truncation; the full detail goes to BENCH_DETAIL.json (and to a
+    # penultimate stdout line for log spelunking — anything lost to
+    # truncation there is still in the file). ----
+    detail_payload = {"detail": detail, "errors": errors}
+    detail_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+    )
+    try:
+        with open(detail_file, "w") as fh:
+            json.dump(detail_payload, fh, indent=1)
+    except OSError as exc:
+        errors["detail_file"] = f"{type(exc).__name__}: {exc}"
+    print("DETAIL " + json.dumps(detail_payload))
+
+    headline = {
         "metric": "autoencoder models trained/hour/chip (fleet vmap engine)",
         "value": fleet_rate,
         "unit": "models/hour/chip",
-        "vs_baseline": (
-            round(fleet_rate / seq_rate, 2)
-            if fleet_rate and seq_rate and same_platform
-            else None
-        ),
-        "detail": detail,
+        "vs_baseline": vs_baseline,
+        "platform": platform,
+        "device_kind": device_kind,
+        "n_devices": n_devices,
+        "mfu": detail.get("mfu"),
+        "hbm_fraction_of_peak": detail.get("hbm_fraction_of_peak"),
+        "detail_file": "BENCH_DETAIL.json",
     }
     if errors:
-        result["errors"] = errors
-    print(json.dumps(result))
+        # compact error digest: full strings live in the detail file
+        digest = {k: str(v)[:100] for k, v in list(errors.items())[:6]}
+        if len(errors) > 6:
+            digest["..."] = f"+{len(errors) - 6} more in BENCH_DETAIL.json"
+        headline["errors"] = digest
+    line = json.dumps(headline)
+    if len(line) > 1000:
+        # hard cap: the headline must survive any sane tail capture
+        headline.pop("errors", None)
+        headline["errors_truncated"] = True
+        line = json.dumps(headline)
+    print(line)
     return 0 if fleet_rate else 1
 
 

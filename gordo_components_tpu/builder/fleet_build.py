@@ -9,7 +9,6 @@ model configs — so arbitrary reference-style configs still work inside a
 gang.
 """
 
-import concurrent.futures
 import copy
 import logging
 import os
@@ -24,10 +23,9 @@ from gordo_components_tpu.builder.build_model import (
     calculate_model_key,
     provide_saved_model,
 )
-from gordo_components_tpu.dataset import get_dataset
 from gordo_components_tpu.parallel.fleet import FleetTrainer
 from gordo_components_tpu.utils import metadata_timestamp
-from gordo_components_tpu.utils.staging import load_worker_count
+from gordo_components_tpu.utils.staging import stage_members
 from gordo_components_tpu.workflow.config import Machine
 
 logger = logging.getLogger(__name__)
@@ -358,26 +356,15 @@ def _build_fleet_group(
         return
 
     # host-side data loading (the IO hot loop, SURVEY.md §3.1). One process
-    # feeds the whole gang here (SURVEY.md §7 hard part 2), so members load
-    # concurrently: providers are IO-bound against real stores and the
-    # pandas/numpy join path releases the GIL for much of its work.
+    # feeds the whole gang here (SURVEY.md §7 hard part 2); stage_members
+    # owns worker count and thread-vs-process engine selection
+    # (utils/staging.py) so builds and the bench measure the same path.
     if heartbeat is not None:
         heartbeat.update(phase="loading", group_members=len(pending))
     t0 = time.time()
-
-    def _load(machine):
-        ds = get_dataset(dict(machine.dataset))
-        X, _y = ds.get_data()
-        return X, ds.get_metadata()
-
-    workers = load_worker_count(len(pending))
+    loaded = stage_members([dict(m.dataset) for m in pending])
     member_data: Dict[str, np.ndarray] = {}
     datasets_meta: Dict[str, Dict] = {}
-    if workers > 1:
-        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
-            loaded = list(pool.map(_load, pending))
-    else:
-        loaded = [_load(m) for m in pending]
     for machine, (X, meta) in zip(pending, loaded):
         member_data[machine.name] = X  # DataFrame: trainer keeps tag names
         datasets_meta[machine.name] = meta

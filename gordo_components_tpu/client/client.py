@@ -67,7 +67,13 @@ class Client:
         # encode/decode dominates at fleet-backfill scale — the reference's
         # client used parquet for the same reason); True forces parquet,
         # False forces JSON. A mid-run parquet rejection (foreign server)
-        # downgrades the rest of the run to JSON.
+        # downgrades the rest of an "auto" run to JSON. Normalized here so
+        # truthy non-True values (1, "yes") can't get auto-mode downgrade
+        # semantics while claiming forced mode.
+        if use_parquet not in (True, False, "auto"):
+            raise ValueError(
+                f"use_parquet must be True, False or 'auto', got {use_parquet!r}"
+            )
         self.use_parquet = use_parquet
         self._parquet_active = False
 

@@ -86,13 +86,18 @@ class ModelCollection:
         leaves the previous consistent state serving."""
         on_disk = self._scan()
         models, metadata = dict(self.models), dict(self.metadata)
+        # mtimes stage on a copy too: recording them eagerly would let a
+        # load failure later in the scan mark an ALREADY-RELOADED name as
+        # current while its new model was discarded with the staged dicts
+        # — serving the stale model forever after
+        mtimes = dict(self._mtimes)
         added, updated, removed = [], [], []
         for name in list(models):
             if name not in on_disk:
                 removed.append(name)
                 del models[name]
                 metadata.pop(name, None)
-                self._mtimes.pop(name, None)
+                mtimes.pop(name, None)
         for name, path in on_disk.items():
             try:
                 mtime = os.path.getmtime(os.path.join(path, "model.pkl"))
@@ -100,13 +105,14 @@ class ModelCollection:
                 continue
             if name not in models:
                 self._load_one(models, metadata, name, path)
-                self._mtimes[name] = mtime
+                mtimes[name] = mtime
                 added.append(name)
-            elif mtime != self._mtimes.get(name):
+            elif mtime != mtimes.get(name):
                 self._load_one(models, metadata, name, path)
-                self._mtimes[name] = mtime
+                mtimes[name] = mtime
                 updated.append(name)
         self._state = (models, metadata)  # atomic publish
+        self._mtimes = mtimes
         if added or updated or removed:
             logger.info(
                 "Collection refresh: +%d ~%d -%d (now %d models)",

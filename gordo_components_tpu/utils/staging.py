@@ -1,19 +1,113 @@
-"""Host-staging concurrency policy.
+"""Host-staging concurrency for gang builds.
 
-One process stages data for a whole gang (SURVEY.md §7 hard part 2), so the
-member-loading pool size is an operator lever: ``GORDO_LOAD_WORKERS``
-overrides the default of ``min(8, cores)``. Shared by the fleet builder and
-``bench.py``'s host_pipeline metric so the benchmark measures the same
-concurrency a fleet build actually uses.
+One process stages data for a whole gang (SURVEY.md §7 hard part 2), so
+member-loading throughput bounds fleet build throughput together with the
+device step. This module owns the policy AND the engine:
+
+- ``load_worker_count``: pool size. ``GORDO_LOAD_WORKERS`` overrides the
+  default of ``min(8, max(4, cores))`` — the floor matters: provider IO
+  (Influx/object stores) overlaps even on small hosts, and the old
+  ``min(8, cores)`` collapsed to 1 on single-core builders, silently
+  disabling concurrency (BENCH r2 showed ``threads: 1``).
+- ``stage_members``: run the provider→resample→join→dropna path for many
+  members. ``GORDO_LOAD_MODE`` picks the engine: ``thread`` (IO overlap;
+  pandas/numpy hold the GIL for much of the join), ``process`` (true CPU
+  parallelism via spawned workers — each pays a ~3s import, so only worth
+  it for large member counts on multi-core hosts), ``sync``, or ``auto``
+  (process exactly when cores, workers, and member count all warrant it).
+
+Shared by the fleet builder and ``bench.py``'s host_pipeline metric so the
+benchmark measures the same engine a fleet build actually uses.
 """
 
+import concurrent.futures
+import logging
+import multiprocessing
 import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
-def load_worker_count(n_tasks: int | None = None) -> int:
-    """Member-loading thread count: ``GORDO_LOAD_WORKERS`` or
-    ``min(8, cores)``, clamped to ``n_tasks`` when given."""
-    workers = int(os.environ.get("GORDO_LOAD_WORKERS", min(8, os.cpu_count() or 1)))
+def load_worker_count(n_tasks: Optional[int] = None) -> int:
+    """Member-loading pool size: ``GORDO_LOAD_WORKERS`` or
+    ``min(8, max(4, cores))``, clamped to ``n_tasks`` when given."""
+    workers = int(
+        os.environ.get(
+            "GORDO_LOAD_WORKERS", min(8, max(4, os.cpu_count() or 1))
+        )
+    )
     if n_tasks is not None:
         workers = min(workers, n_tasks)
     return max(1, workers)
+
+
+def load_mode(n_tasks: int, workers: int) -> str:
+    """Engine selection: ``GORDO_LOAD_MODE`` or ``auto``.
+
+    ``auto`` picks ``process`` only when every leg pays off: >1 core
+    (else spawned workers just time-slice), >1 worker, and enough members
+    to amortize the ~3s per-worker interpreter spin-up; ``thread``
+    otherwise (free to start, overlaps provider IO, and the fused
+    numpy resample releases the GIL for part of the join)."""
+    mode = os.environ.get("GORDO_LOAD_MODE", "auto")
+    if mode not in ("auto", "thread", "process", "sync"):
+        raise ValueError(f"GORDO_LOAD_MODE must be auto|thread|process|sync, got {mode!r}")
+    if mode == "auto":
+        cores = os.cpu_count() or 1
+        mode = (
+            "process"
+            if cores > 1 and workers > 1 and n_tasks >= 16 * workers
+            else "thread"
+        )
+    return mode
+
+
+def _stage_one(config: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
+    """Build one member's dataset from its config dict and load it.
+    Top-level so process pools can pickle it; imports stay inside so
+    spawned workers never touch JAX device state."""
+    from gordo_components_tpu.dataset import get_dataset
+
+    ds = get_dataset(dict(config))
+    X, _y = ds.get_data()
+    return X, ds.get_metadata()
+
+
+def stage_members(
+    configs: List[Dict[str, Any]],
+    workers: Optional[int] = None,
+    mode: Optional[str] = None,
+) -> List[Tuple[Any, Dict[str, Any]]]:
+    """Stage every member's ``(X, dataset_metadata)`` — in input order —
+    through the chosen engine. Non-picklable configs (e.g. injected
+    provider objects) silently use threads instead of processes."""
+    n = len(configs)
+    if workers is None:
+        workers = load_worker_count(n)
+    if mode is None:
+        mode = load_mode(n, workers)
+    if n <= 1 or workers <= 1 or mode == "sync":
+        return [_stage_one(c) for c in configs]
+    if mode == "process":
+        try:
+            pickle.dumps(configs)
+        except Exception:
+            logger.info("member configs not picklable; staging with threads")
+            mode = "thread"
+    if mode == "process":
+        # spawn, not fork: the parent usually has a live XLA backend and
+        # forking a process with running runtime threads can deadlock in
+        # inherited locks. Workers only run pandas/numpy.
+        ctx = multiprocessing.get_context("spawn")
+        with concurrent.futures.ProcessPoolExecutor(
+            workers, mp_context=ctx
+        ) as pool:
+            return list(
+                pool.map(
+                    _stage_one, configs, chunksize=max(1, n // (workers * 4))
+                )
+            )
+    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+        return list(pool.map(_stage_one, configs))

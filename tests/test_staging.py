@@ -1,0 +1,147 @@
+"""Host-staging engine tests (SURVEY.md §7 hard part 2): the pool policy
+and the thread/process engines must produce identical datasets, and the
+worker floor must engage concurrency even on single-core builders."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.utils.staging import (
+    load_mode,
+    load_worker_count,
+    stage_members,
+)
+
+
+def _configs(n, rows_days=2, tags=3):
+    return [
+        {
+            "type": "RandomDataset",
+            "train_start_date": "2020-01-01",
+            "train_end_date": f"2020-01-{rows_days + 1:02d}",
+            "tag_list": [f"stage-{i}-{j}" for j in range(tags)],
+        }
+        for i in range(n)
+    ]
+
+
+class TestPolicy:
+    def test_worker_floor_engages_on_small_hosts(self, monkeypatch):
+        # the old min(8, cores) collapsed to 1 on single-core builders,
+        # silently disabling concurrency (BENCH r2: threads=1)
+        monkeypatch.delenv("GORDO_LOAD_WORKERS", raising=False)
+        assert load_worker_count() >= 4
+        assert load_worker_count(2) == 2  # still clamped to the task count
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("GORDO_LOAD_WORKERS", "6")
+        assert load_worker_count() == 6
+
+    def test_mode_env_override_and_validation(self, monkeypatch):
+        monkeypatch.setenv("GORDO_LOAD_MODE", "thread")
+        assert load_mode(1000, 8) == "thread"
+        monkeypatch.setenv("GORDO_LOAD_MODE", "bogus")
+        with pytest.raises(ValueError, match="GORDO_LOAD_MODE"):
+            load_mode(10, 2)
+
+    def test_auto_needs_cores_workers_and_scale(self, monkeypatch):
+        monkeypatch.delenv("GORDO_LOAD_MODE", raising=False)
+        import gordo_components_tpu.utils.staging as staging
+
+        monkeypatch.setattr(staging.os, "cpu_count", lambda: 8)
+        assert load_mode(1000, 8) == "process"
+        assert load_mode(32, 8) == "thread"  # too few members to amortize
+        monkeypatch.setattr(staging.os, "cpu_count", lambda: 1)
+        assert load_mode(10000, 8) == "thread"  # one core: spawn is waste
+
+
+class TestEngines:
+    def test_thread_matches_sync(self):
+        configs = _configs(6)
+        sync = stage_members(configs, workers=1)
+        threaded = stage_members(configs, workers=4, mode="thread")
+        assert len(sync) == len(threaded) == 6
+        for (xs, ms), (xt, mt) in zip(sync, threaded):
+            pd.testing.assert_frame_equal(xs, xt)
+            assert ms["tag_list"] == mt["tag_list"]
+
+    def test_process_matches_sync(self):
+        # spawn workers pay a real interpreter+import start-up (~3s each);
+        # 2 workers keeps this test bounded while proving the engine
+        configs = _configs(6, rows_days=1)
+        sync = stage_members(configs, workers=1)
+        proc = stage_members(configs, workers=2, mode="process")
+        for (xs, _), (xp, _) in zip(sync, proc):
+            pd.testing.assert_frame_equal(xs, xp)
+
+    def test_non_picklable_configs_fall_back_to_threads(self):
+        from gordo_components_tpu.dataset.data_provider.providers import (
+            RandomDataProvider,
+        )
+
+        configs = [
+            {
+                "type": "TimeSeriesDataset",
+                "train_start_date": "2020-01-01",
+                "train_end_date": "2020-01-02",
+                "tag_list": ["a", "b"],
+                # a live provider object with a lambda makes the config
+                # unpicklable; staging must degrade to threads, not crash
+                "data_provider": type(
+                    "P",
+                    (RandomDataProvider,),
+                    {"marker": staticmethod(lambda: None)},
+                )(),
+            }
+            for _ in range(3)
+        ]
+        out = stage_members(configs, workers=2, mode="process")
+        assert len(out) == 3
+        for X, _ in out:
+            assert len(X) > 0
+
+
+def test_fleet_build_stages_through_engine(tmp_path):
+    """The gang builder loads members via stage_members (order-preserving:
+    member data must land under the right machine name)."""
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.workflow.config import Machine
+
+    model = {
+        "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "sklearn.pipeline.Pipeline": {
+                    "steps": [
+                        "sklearn.preprocessing.MinMaxScaler",
+                        {
+                            "gordo_components_tpu.models.AutoEncoder": {
+                                "epochs": 1,
+                                "batch_size": 64,
+                            }
+                        },
+                    ]
+                }
+            }
+        }
+    }
+    machines = [
+        Machine(
+            name=f"sm-{i}",
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01",
+                "train_end_date": "2020-01-02",
+                "tag_list": [f"t-{i}-{j}" for j in range(3)],
+            },
+            model=model,
+        )
+        for i in range(3)
+    ]
+    results = build_fleet(machines, str(tmp_path))
+    assert set(results) == {"sm-0", "sm-1", "sm-2"}
+    from gordo_components_tpu import serializer
+
+    for i in range(3):
+        det = serializer.load(results[f"sm-{i}"])
+        # tags prove the right member data reached the right machine
+        assert det.tags_ == [f"t-{i}-{j}" for j in range(3)]
