@@ -97,6 +97,8 @@ def fused_agg_join(
 
     meta: Dict[str, Any] = {}
     cols: List[Tuple[Any, Any, int, np.ndarray]] = []  # (name, dtype, lo, mean)
+    # per-id(index) cache of the index-only window/bucket arithmetic
+    index_cache: Dict[int, Tuple] = {}
     tz = None
     index_name = None
     units = set()  # non-nano datetime units (pandas 2.x): preserved on output
@@ -142,25 +144,51 @@ def fused_agg_join(
         # normalize to ns for the bucket arithmetic. Direct int64
         # multiplication instead of index.as_unit("ns"): the pandas
         # conversion re-validates per element and measured as ~40% of the
-        # whole staging wall time (profiled at fleet scale).
-        unit = getattr(series.index, "unit", "ns")
-        factor = _UNIT_NS.get(unit)
-        if factor is None:
-            return None
-        units.add(unit)
-        ts = series.index.asi8
-        if factor != 1:
-            lim = (2**63 - 1) // factor
-            if ts.size and (ts.max() > lim or ts.min() < -lim):
-                # far-range timestamps (or NaT sentinels) in a coarser
-                # unit don't fit int64 ns; pandas resamples in the native
-                # unit, so hand the case back
+        # whole staging wall time (profiled at fleet scale). The derived
+        # window mask / bucket offsets are index-only, and tags loaded
+        # from one provider query usually SHARE one index object — cache
+        # per id(index) so N tags pay the arithmetic once.
+        cached = index_cache.get(id(series.index))
+        if cached is None:
+            unit = getattr(series.index, "unit", "ns")
+            factor = _UNIT_NS.get(unit)
+            if factor is None:
                 return None
-            ts = ts * factor
-        keep = (ts >= start_ns) & (ts < end_ns)
-        ts = ts[keep]
-        vals = np.asarray(series.values)[keep]
-        if ts.size == 0:
+            ts = series.index.asi8
+            if factor != 1:
+                lim = (2**63 - 1) // factor
+                if ts.size and (ts.max() > lim or ts.min() < -lim):
+                    # far-range timestamps (or NaT sentinels) in a coarser
+                    # unit don't fit int64 ns; pandas resamples in the
+                    # native unit, so hand the case back
+                    return None
+                ts = ts * factor
+            keep = (ts >= start_ns) & (ts < end_ns)
+            if keep.all():
+                keep = None  # in-window: skip the fancy-index copy per tag
+            else:
+                ts = ts[keep]
+            if ts.size == 0:
+                cached = (unit, keep, -1, None, 0)
+            else:
+                bucket = ts // res_ns
+                lo = int(bucket.min())
+                n = int(bucket.max()) - lo + 1
+                if n > _MAX_BUCKETS:
+                    return None
+                offs = (bucket - lo).astype(np.int64)
+                cached = (unit, keep, lo, offs, n)
+            # keep the index object alive: id() keys are only unique
+            # while the object is — the cache value pins it
+            index_cache[id(series.index)] = cached + (series.index,)
+        else:
+            cached = cached[:5]
+        unit, keep, lo, offs, n = cached
+        units.add(unit)
+        vals = np.asarray(series.values)
+        if keep is not None:
+            vals = vals[keep]
+        if lo == -1:
             # out-of-window: the pandas path resamples an empty slice,
             # which mean-widens the dtype (float32 stays, ints -> float64)
             meta[str(name)]["rows_resampled"] = 0
@@ -169,14 +197,6 @@ def fused_agg_join(
             )
             cols.append((name, out_dtype, -1, np.empty(0)))
             continue
-
-        bucket = ts // res_ns
-        lo = int(bucket.min())
-        hi = int(bucket.max())
-        n = hi - lo + 1
-        if n > _MAX_BUCKETS:
-            return None
-        offs = (bucket - lo).astype(np.int64)
         try:
             fvals = vals.astype(np.float64, copy=False)
         except (ValueError, TypeError):
