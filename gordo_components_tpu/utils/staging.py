@@ -32,12 +32,15 @@ logger = logging.getLogger(__name__)
 
 def load_worker_count(n_tasks: Optional[int] = None) -> int:
     """Member-loading pool size: ``GORDO_LOAD_WORKERS`` or
-    ``min(8, max(4, cores))``, clamped to ``n_tasks`` when given."""
-    workers = int(
-        os.environ.get(
-            "GORDO_LOAD_WORKERS", min(8, max(4, os.cpu_count() or 1))
-        )
-    )
+    ``min(8, max(4, cores))``, clamped to ``n_tasks`` when given.
+    ``"auto"`` (or empty) means the per-host default — the workflow
+    generator renders it so manifests don't pin a count that defeats
+    per-host sizing."""
+    raw = os.environ.get("GORDO_LOAD_WORKERS", "").strip()
+    if raw and raw != "auto":
+        workers = int(raw)
+    else:
+        workers = min(8, max(4, os.cpu_count() or 1))
     if n_tasks is not None:
         workers = min(workers, n_tasks)
     return max(1, workers)

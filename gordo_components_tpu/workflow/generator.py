@@ -30,6 +30,11 @@ DEFAULTS: Dict[str, Any] = {
     "server_devices": 8,
     "server_replicas": 1,
     "builder_retries": 3,
+    # host-staging engine for builder pods (utils/staging.py): gang
+    # builders on multi-core k8s hosts want the process pool for the
+    # CPU-bound resample/join; "auto" sizes/selects per host
+    "load_workers": "auto",
+    "load_mode": "auto",
     "artifact_root": "/gordo/models",
     "artifact_pvc": "gordo-models",
     "models_per_gang": 1024,
@@ -44,6 +49,17 @@ def generate_workflow(
 ) -> str:
     """Render the full multi-document manifest YAML for a project."""
     params = {**DEFAULTS, **(config.runtime or {}), **overrides}
+    # staging knobs deploy to EVERY builder pod: a typo here would
+    # crashloop the whole fleet at stage time, so fail at generation
+    if str(params["load_mode"]) not in ("auto", "thread", "process", "sync"):
+        raise ValueError(
+            f"load_mode must be auto|thread|process|sync, got {params['load_mode']!r}"
+        )
+    lw = str(params["load_workers"])
+    if lw != "auto" and not lw.isdigit():
+        raise ValueError(
+            f"load_workers must be 'auto' or an integer, got {params['load_workers']!r}"
+        )
     gangs = schedule_gangs(
         config.machines,
         models_per_gang=int(params["models_per_gang"]),

@@ -169,3 +169,37 @@ def test_watchman_deployment_reads_gang_state():
     assert env["GANG_STATE_DIR"].endswith("/.gang-state/p")
     mounts = {m["name"] for m in container["volumeMounts"]}
     assert "artifacts" in mounts
+
+
+def test_builder_jobs_carry_staging_env():
+    """Gang builder Jobs plumb the host-staging engine knobs so member
+    loading parallelizes across each pod's cores (utils/staging.py)."""
+    config = NormalizedConfig(CONFIG_YAML)
+    docs = [
+        d
+        for d in yaml.safe_load_all(
+            generate_workflow(config, "p", load_workers=6, load_mode="process")
+        )
+        if d
+    ]
+    jobs = [d for d in docs if d.get("kind") == "Job"]
+    assert jobs
+    for job in jobs:
+        env = {
+            e["name"]: e.get("value")
+            for e in job["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["GORDO_LOAD_WORKERS"] == "6"
+        assert env["GORDO_LOAD_MODE"] == "process"
+
+
+def test_staging_env_defaults_to_auto_and_validates():
+    """Default manifests render 'auto' (per-host sizing stays live in the
+    pod), and typos fail at GENERATION, not as a fleet-wide crashloop."""
+    config = NormalizedConfig(CONFIG_YAML)
+    manifest = generate_workflow(config, "p")
+    assert '{name: GORDO_LOAD_WORKERS, value: "auto"}' in manifest
+    with pytest.raises(ValueError, match="load_mode"):
+        generate_workflow(config, "p", load_mode="proces")
+    with pytest.raises(ValueError, match="load_workers"):
+        generate_workflow(config, "p", load_workers="many")
