@@ -166,13 +166,23 @@ class Client:
                     self.forwarder.forward(result)
         return list(results)
 
-    async def _post_parquet(self, session, target, endpoint, chunk: pd.DataFrame):
+    async def _post_parquet(
+        self, session, target, endpoint, chunk: pd.DataFrame,
+        chunk_y: Optional[pd.DataFrame] = None,
+    ):
         """POST one chunk as a parquet body (index rides inside the file,
-        so timestamps round-trip without the JSON string lists)."""
+        so timestamps round-trip without the JSON string lists). Target
+        columns for supervised machines are embedded under a ``__y__``
+        prefix; the server splits them back out (server/utils.py)."""
         import io
 
+        frame = chunk
+        if chunk_y is not None:
+            # indices are identical by construction (iloc slices of the
+            # same row range), so this is a pure column concat
+            frame = pd.concat([chunk, chunk_y.add_prefix("__y__")], axis=1)
         buf = io.BytesIO()
-        chunk.to_parquet(buf)
+        frame.to_parquet(buf)
         return await fetch_json(
             session,
             self._url(target, endpoint),
@@ -199,13 +209,13 @@ class Client:
         frames: List[pd.DataFrame] = []
         errors: List[str] = []
 
-        async def post_chunk(chunk: pd.DataFrame):
+        async def post_chunk(chunk: pd.DataFrame, chunk_y: Optional[pd.DataFrame]):
             async with sem:
                 parquet_exc = None
                 if self._parquet_active:
                     try:
                         return await self._post_parquet(
-                            session, target, endpoint, chunk
+                            session, target, endpoint, chunk, chunk_y
                         )
                     except ValueError as exc:
                         # 4xx on the parquet body. Ambiguous: the server
@@ -225,6 +235,8 @@ class Client:
                     "X": chunk.values.tolist(),
                     "index": [str(i) for i in chunk.index],
                 }
+                if chunk_y is not None:
+                    payload["y"] = chunk_y.values.tolist()
                 try:
                     body = await fetch_json(
                         session,
@@ -247,11 +259,17 @@ class Client:
                     self._parquet_active = False
                 return body
 
+        # y rides along for supervised machines (target_tag_list): the
+        # anomaly diff must be computed against the TRAINED target, not
+        # X->X — silently dropping y here would score the wrong objective
         chunks = [
-            X.iloc[i : i + self.batch_size]
+            (
+                X.iloc[i : i + self.batch_size],
+                None if y is None else y.iloc[i : i + self.batch_size],
+            )
             for i in range(0, len(X), self.batch_size)
         ]
-        bodies = await asyncio.gather(*(post_chunk(c) for c in chunks))
+        bodies = await asyncio.gather(*(post_chunk(cx, cy) for cx, cy in chunks))
         for body in bodies:
             if body is None:
                 continue

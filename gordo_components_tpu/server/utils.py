@@ -73,6 +73,12 @@ def extract_x_y(
     """
     if "parquet" in content_type:
         df = pd.read_parquet(io.BytesIO(raw))
+        # supervised targets ride in the same file under a __y__ prefix
+        # (client/client.py::_post_parquet): split them back out
+        ycols = [c for c in df.columns if str(c).startswith("__y__")]
+        if ycols:
+            y = df[ycols].rename(columns=lambda c: str(c)[len("__y__"):])
+            return df.drop(columns=ycols), y
         return df, None
     if not body or "X" not in body:
         raise ValueError("Request must contain 'X'")

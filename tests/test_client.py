@@ -199,3 +199,70 @@ async def test_client_parquet_downgrades_when_rejected():
     assert 1 <= seen["parquet"] <= seen["json"]
     assert seen["json"] == 4  # 36 rows / batch 10 -> all 4 chunks scored
     assert client._parquet_active is False
+
+
+SUPERVISED_DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2017-12-25 06:00:00Z",
+    "train_end_date": "2017-12-26 06:00:00Z",
+    "tag_list": ["in-0", "in-1", "in-2"],
+    "target_tag_list": ["out-0", "out-1", "out-2"],
+}
+
+
+@pytest.fixture(scope="module")
+def supervised_collection_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("client-supervised")
+    provide_saved_model(
+        "sup-a", MODEL_CONFIG, SUPERVISED_DATA_CONFIG,
+        output_dir=str(root / "sup-a"),
+    )
+    return str(root)
+
+
+@pytest.mark.parametrize("use_parquet", [False, True])
+async def test_client_posts_y_for_supervised_machines(
+    supervised_collection_dir, live_server, use_parquet
+):
+    """A target_tag_list machine's anomaly diff must be computed against
+    the TRAINED target: the client threads y through both encodings
+    (JSON "y" field; __y__-prefixed parquet columns), and the scored
+    frames match local det.anomaly(X, y) exactly."""
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.dataset import get_dataset
+
+    start = pd.Timestamp("2017-12-25 06:00:00Z")
+    end = pd.Timestamp("2017-12-25 09:00:00Z")
+    async with live_server(supervised_collection_dir) as base_url:
+        client = Client(
+            "proj", base_url=base_url, batch_size=8, use_parquet=use_parquet
+        )
+        results = await client.predict_async(start, end)
+    res = results[0]
+    assert res.ok, res.error_messages
+
+    # local ground truth over the identical (deterministic) dataset
+    det = serializer.load(f"{supervised_collection_dir}/sup-a")
+    ds = get_dataset(
+        {
+            **SUPERVISED_DATA_CONFIG,
+            "train_start_date": str(start),
+            "train_end_date": str(end),
+        }
+    )
+    X, y = ds.get_data()
+    assert y is not None and list(y.columns) == ["out-0", "out-1", "out-2"]
+    expected = det.anomaly(X, y)
+    got = res.predictions.sort_index()
+    np.testing.assert_allclose(
+        got[("total-anomaly-scaled", "")].values,
+        expected[("total-anomaly-scaled", "")].values,
+        rtol=1e-5,
+    )
+    # the unscaled per-tag diff only matches when y actually reached the
+    # server: X->X scoring would differ everywhere
+    np.testing.assert_allclose(
+        got["tag-anomaly-unscaled"].values,
+        expected["tag-anomaly-unscaled"].values,
+        rtol=1e-5,
+    )
