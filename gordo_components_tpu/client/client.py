@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional
 import aiohttp
 import pandas as pd
 
-from gordo_components_tpu.client.io import fetch_json
+from gordo_components_tpu.client.io import fetch_json, fetch_metadata_all
 from gordo_components_tpu.dataset import get_dataset
 from gordo_components_tpu.server.utils import dict_to_frame
 from gordo_components_tpu.utils import parquet_engine_available
@@ -76,6 +76,7 @@ class Client:
             )
         self.use_parquet = use_parquet
         self._parquet_active = False
+        self._metadata_all: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------ #
 
@@ -83,8 +84,27 @@ class Client:
         return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
 
     async def _get_metadata(self, session, target: str) -> Dict[str, Any]:
+        meta = self._metadata_all.get(target) if self._metadata_all else None
+        if meta is not None:
+            return meta
         body = await fetch_json(session, self._url(target, "metadata"))
         return body.get("endpoint-metadata", {})
+
+    async def _prefetch_metadata(self, session) -> None:
+        """Prefetch every target's metadata in ONE request via the
+        collection server's batched control-plane endpoint — at fleet
+        scale the per-target ``/metadata`` round-trips otherwise cost N
+        requests before any scoring starts. Best-effort with a short
+        deadline and shape validation (shared helper, client/io.py):
+        foreign servers keep the per-target path."""
+        body = await fetch_metadata_all(session, self.base_url, self.project)
+        if body is None:
+            return
+        self._metadata_all = {
+            name: entry["endpoint-metadata"]
+            for name, entry in body["targets"].items()
+            if isinstance(entry, dict) and "endpoint-metadata" in entry
+        }
 
     def _dataset_config_from_metadata(self, meta, start, end) -> Dict[str, Any]:
         ds_meta = meta.get("dataset", {})
@@ -140,6 +160,14 @@ class Client:
                     models_body = None  # encoding probe is best-effort
             if targets is None:
                 targets = models_body["models"]
+            # fresh per run: stale cached metadata must never outlive a
+            # server-side /reload (a failed re-prefetch then falls back to
+            # per-target fetches, not to last run's cache)
+            self._metadata_all = {}
+            if len(targets) >= 8:
+                # below that, per-target GETs are cheaper than pulling the
+                # whole fleet's metadata for a handful of lookups
+                await self._prefetch_metadata(session)
             if self.use_parquet == "auto":
                 self._parquet_active = parquet_engine_available() and any(
                     "parquet" in a

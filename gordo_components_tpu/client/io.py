@@ -18,6 +18,42 @@ class HttpUnprocessableEntity(Exception):
     retrying)."""
 
 
+async def fetch_metadata_all(
+    session: aiohttp.ClientSession,
+    base_url: str,
+    project: str,
+    deadline: float = 10.0,
+) -> Optional[Dict[str, Any]]:
+    """One-request control-plane snapshot from the collection server's
+    ``metadata-all`` endpoint, shared by watchman and the bulk client.
+
+    Best-effort by contract: returns the validated body (a dict with a
+    dict ``targets``) or None on non-200, timeout, or malformed/foreign
+    responses — callers fall back to per-target requests. The ``deadline``
+    matters because this runs serially BEFORE the fallback: a foreign
+    endpoint that accepts the connection but hangs must not stall the
+    caller by the full session timeout (or fetch retries)."""
+
+    async def get():
+        async with session.get(
+            f"{base_url.rstrip('/')}/gordo/v0/{project}/metadata-all"
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+
+    try:
+        body = await asyncio.wait_for(get(), timeout=deadline)
+    except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
+        # ValueError covers json.JSONDecodeError on a malformed 200
+        logger.debug("metadata-all fetch failed: %s", exc)
+        return None
+    if not isinstance(body, dict) or not isinstance(body.get("targets"), dict):
+        # a catch-all proxy can 200 unknown paths with arbitrary JSON
+        return None
+    return body
+
+
 async def fetch_json(
     session: aiohttp.ClientSession,
     url: str,

@@ -80,32 +80,12 @@ class WatchmanState:
         target's health + metadata in ONE request (O(1) per snapshot
         instead of O(2N) per-target polls hammering the process that also
         serves scoring traffic). Returns None when the server doesn't
-        speak it (non-200), so foreign per-model servers keep working via
-        the per-target fallback."""
-        async def get():
-            async with session.get(
-                f"{self.base_url}/gordo/v0/{self.project}/metadata-all"
-            ) as resp:
-                if resp.status != 200:
-                    return None
-                return await resp.json()
+        speak it, so foreign per-model servers keep working via the
+        per-target fallback (shared deadline + shape-validation contract:
+        client/io.py::fetch_metadata_all)."""
+        from gordo_components_tpu.client.io import fetch_metadata_all
 
-        try:
-            # own short deadline: this pre-flight runs serially BEFORE the
-            # fallback, so a foreign endpoint that accepts the connection
-            # but hangs must not stall every snapshot by the full 30s
-            # client timeout
-            body = await asyncio.wait_for(get(), timeout=10.0)
-        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
-            # ValueError covers json.JSONDecodeError: a malformed 200 must
-            # fall back, not crash the snapshot
-            logger.debug("metadata-all fetch failed: %s", exc)
-            return None
-        if not isinstance(body, dict) or not isinstance(body.get("targets"), dict):
-            # a catch-all proxy can 200 unknown paths with arbitrary JSON;
-            # treat anything without the contract shape as "not spoken"
-            return None
-        return body
+        return await fetch_metadata_all(session, self.base_url, self.project)
 
     async def snapshot(self) -> Dict[str, Any]:
         async with self._lock:

@@ -266,3 +266,117 @@ async def test_client_posts_y_for_supervised_machines(
         expected["tag-anomaly-unscaled"].values,
         rtol=1e-5,
     )
+
+
+async def test_client_prefetches_metadata_in_one_request():
+    """Against a collection server the client must not issue per-target
+    /metadata GETs — the metadata-all prefetch covers all N targets."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    counts = {"metadata": 0, "metadata_all": 0}
+    names = [f"m-{i}" for i in range(10)]
+
+    async def models(request):
+        return web.json_response({"models": names, "accepts": []})
+
+    async def metadata_all(request):
+        counts["metadata_all"] += 1
+        return web.json_response(
+            {
+                "targets": {
+                    n: {"healthy": True, "endpoint-metadata": {}} for n in names
+                }
+            }
+        )
+
+    async def metadata(request):
+        counts["metadata"] += 1
+        return web.json_response({"endpoint-metadata": {}})
+
+    async def predict(request):
+        body = await request.json()
+        return web.json_response(
+            {"data": [[0.0] * 2] * len(body["X"]), "index": body["index"]}
+        )
+
+    app = web.Application()
+    app.router.add_get("/gordo/v0/proj/models", models)
+    app.router.add_get("/gordo/v0/proj/metadata-all", metadata_all)
+    app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
+    app.router.add_post(
+        "/gordo/v0/proj/{target}/anomaly/prediction", predict
+    )
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        client = Client(
+            "proj",
+            base_url=f"http://{server.host}:{server.port}",
+            metadata_fallback_dataset={
+                "type": "RandomDataset",
+                "tag_list": ["a", "b"],
+            },
+        )
+        results = await client.predict_async(
+            pd.Timestamp("2020-01-01 00:00:00Z"),
+            pd.Timestamp("2020-01-01 02:00:00Z"),
+        )
+    finally:
+        await server.close()
+    assert all(r.ok for r in results), [r.error_messages for r in results]
+    assert len(results) == 10
+    assert counts["metadata_all"] == 1
+    assert counts["metadata"] == 0  # no per-target metadata round-trips
+
+
+async def test_client_small_explicit_target_list_skips_prefetch():
+    """A handful of explicit targets costs per-target GETs, not a
+    whole-fleet metadata-all download."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    counts = {"metadata": 0, "metadata_all": 0}
+
+    async def metadata_all(request):
+        counts["metadata_all"] += 1
+        return web.json_response({"targets": {}})
+
+    async def metadata(request):
+        counts["metadata"] += 1
+        return web.json_response({"endpoint-metadata": {}})
+
+    async def predict(request):
+        body = await request.json()
+        return web.json_response(
+            {"data": [[0.0] * 2] * len(body["X"]), "index": body["index"]}
+        )
+
+    app = web.Application()
+    app.router.add_get("/gordo/v0/proj/metadata-all", metadata_all)
+    app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
+    app.router.add_post(
+        "/gordo/v0/proj/{target}/anomaly/prediction", predict
+    )
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        client = Client(
+            "proj",
+            base_url=f"http://{server.host}:{server.port}",
+            use_parquet=False,
+            metadata_fallback_dataset={
+                "type": "RandomDataset",
+                "tag_list": ["a", "b"],
+            },
+        )
+        results = await client.predict_async(
+            pd.Timestamp("2020-01-01 00:00:00Z"),
+            pd.Timestamp("2020-01-01 02:00:00Z"),
+            targets=["m-0"],
+        )
+    finally:
+        await server.close()
+    assert results[0].ok, results[0].error_messages
+    assert counts["metadata_all"] == 0
+    assert counts["metadata"] == 1
