@@ -23,6 +23,10 @@ from gordo_components_tpu.utils import parquet_engine_available
 
 logger = logging.getLogger(__name__)
 
+# below this many targets, per-target /metadata GETs beat downloading the
+# whole fleet's metadata in one metadata-all response
+_PREFETCH_MIN_TARGETS = 8
+
 
 @dataclass
 class PredictionResult:
@@ -84,7 +88,7 @@ class Client:
         return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
 
     async def _get_metadata(self, session, target: str) -> Dict[str, Any]:
-        meta = self._metadata_all.get(target) if self._metadata_all else None
+        meta = self._metadata_all.get(target)
         if meta is not None:
             return meta
         body = await fetch_json(session, self._url(target, "metadata"))
@@ -164,7 +168,7 @@ class Client:
             # server-side /reload (a failed re-prefetch then falls back to
             # per-target fetches, not to last run's cache)
             self._metadata_all = {}
-            if len(targets) >= 8:
+            if len(targets) >= _PREFETCH_MIN_TARGETS:
                 # below that, per-target GETs are cheaper than pulling the
                 # whole fleet's metadata for a handful of lookups
                 await self._prefetch_metadata(session)

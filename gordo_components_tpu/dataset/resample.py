@@ -148,7 +148,8 @@ def fused_agg_join(
         # window mask / bucket offsets are index-only, and tags loaded
         # from one provider query usually SHARE one index object — cache
         # per id(index) so N tags pay the arithmetic once.
-        cached = index_cache.get(id(series.index))
+        hit = index_cache.get(id(series.index))
+        cached = hit[0] if hit is not None else None
         if cached is None:
             unit = getattr(series.index, "unit", "ns")
             factor = _UNIT_NS.get(unit)
@@ -180,9 +181,7 @@ def fused_agg_join(
                 cached = (unit, keep, lo, offs, n)
             # keep the index object alive: id() keys are only unique
             # while the object is — the cache value pins it
-            index_cache[id(series.index)] = cached + (series.index,)
-        else:
-            cached = cached[:5]
+            index_cache[id(series.index)] = (cached, series.index)
         unit, keep, lo, offs, n = cached
         units.add(unit)
         vals = np.asarray(series.values)

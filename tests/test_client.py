@@ -2,6 +2,8 @@
 mock/in-process HTTP rather than real deployments, SURVEY.md §4). The server
 runs on a real localhost port because ``Client`` owns its own session."""
 
+import contextlib
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -144,43 +146,71 @@ async def test_client_parquet_auto_equals_json(collection_dir, live_server):
     pd.testing.assert_frame_equal(res_pq[0].predictions, res_js[0].predictions)
 
 
-async def test_client_parquet_downgrades_when_rejected():
-    """A server that advertises parquet but rejects the bodies (foreign
-    implementation) must not fail the run: the client re-posts as JSON
-    and downgrades the rest of the run."""
+@contextlib.asynccontextmanager
+async def _stub_collection(names, *, accepts=(), with_metadata_all=True, n_features=2):
+    """Foreign-server stand-in with per-route request counters: JSON
+    predictions echo zeros; parquet bodies are always rejected with 400.
+    Yields (base_url, counts)."""
     from aiohttp import web
     from aiohttp.test_utils import TestServer
 
-    seen = {"parquet": 0, "json": 0}
+    counts = {"models": 0, "metadata": 0, "metadata_all": 0, "parquet": 0, "json": 0}
 
     async def models(request):
+        counts["models"] += 1
+        return web.json_response({"models": list(names), "accepts": list(accepts)})
+
+    async def metadata_all(request):
+        counts["metadata_all"] += 1
         return web.json_response(
-            {"models": ["m-1"], "accepts": ["application/x-parquet"]}
+            {
+                "targets": {
+                    n: {"healthy": True, "endpoint-metadata": {}} for n in names
+                }
+            }
         )
 
     async def metadata(request):
+        counts["metadata"] += 1
         return web.json_response({"endpoint-metadata": {}})
 
     async def predict(request):
         if "parquet" in (request.content_type or ""):
-            seen["parquet"] += 1
+            counts["parquet"] += 1
             raise web.HTTPBadRequest(text='{"error": "no parquet here"}')
-        seen["json"] += 1
+        counts["json"] += 1
         body = await request.json()
         return web.json_response(
-            {"data": [[0.0] * 3] * len(body["X"]), "index": body["index"]}
+            {"data": [[0.0] * n_features] * len(body["X"]), "index": body["index"]}
         )
 
     app = web.Application()
     app.router.add_get("/gordo/v0/proj/models", models)
-    app.router.add_get("/gordo/v0/proj/m-1/metadata", metadata)
-    app.router.add_post("/gordo/v0/proj/m-1/anomaly/prediction", predict)
+    if with_metadata_all:
+        app.router.add_get("/gordo/v0/proj/metadata-all", metadata_all)
+    app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
+    app.router.add_post("/gordo/v0/proj/{target}/anomaly/prediction", predict)
     server = TestServer(app)
     await server.start_server()
     try:
+        yield f"http://{server.host}:{server.port}", counts
+    finally:
+        await server.close()
+
+
+async def test_client_parquet_downgrades_when_rejected():
+    """A server that advertises parquet but rejects the bodies (foreign
+    implementation) must not fail the run: the client re-posts as JSON
+    and downgrades the rest of the run."""
+    async with _stub_collection(
+        ["m-1"],
+        accepts=["application/x-parquet"],
+        with_metadata_all=False,
+        n_features=3,
+    ) as (base_url, counts):
         client = Client(
             "proj",
-            base_url=f"http://{server.host}:{server.port}",
+            base_url=base_url,
             batch_size=10,
             metadata_fallback_dataset={
                 "type": "RandomDataset",
@@ -191,13 +221,11 @@ async def test_client_parquet_downgrades_when_rejected():
             pd.Timestamp("2020-01-01 00:00:00Z"),
             pd.Timestamp("2020-01-01 06:00:00Z"),
         )
-    finally:
-        await server.close()
     assert results[0].ok, results[0].error_messages
     # in-flight chunks may each probe parquet before the first rejection
     # lands, but every one must re-post as JSON in the same call
-    assert 1 <= seen["parquet"] <= seen["json"]
-    assert seen["json"] == 4  # 36 rows / batch 10 -> all 4 chunks scored
+    assert 1 <= counts["parquet"] <= counts["json"]
+    assert counts["json"] == 4  # 36 rows / batch 10 -> all 4 chunks scored
     assert client._parquet_active is False
 
 
@@ -271,48 +299,11 @@ async def test_client_posts_y_for_supervised_machines(
 async def test_client_prefetches_metadata_in_one_request():
     """Against a collection server the client must not issue per-target
     /metadata GETs — the metadata-all prefetch covers all N targets."""
-    from aiohttp import web
-    from aiohttp.test_utils import TestServer
-
-    counts = {"metadata": 0, "metadata_all": 0}
     names = [f"m-{i}" for i in range(10)]
-
-    async def models(request):
-        return web.json_response({"models": names, "accepts": []})
-
-    async def metadata_all(request):
-        counts["metadata_all"] += 1
-        return web.json_response(
-            {
-                "targets": {
-                    n: {"healthy": True, "endpoint-metadata": {}} for n in names
-                }
-            }
-        )
-
-    async def metadata(request):
-        counts["metadata"] += 1
-        return web.json_response({"endpoint-metadata": {}})
-
-    async def predict(request):
-        body = await request.json()
-        return web.json_response(
-            {"data": [[0.0] * 2] * len(body["X"]), "index": body["index"]}
-        )
-
-    app = web.Application()
-    app.router.add_get("/gordo/v0/proj/models", models)
-    app.router.add_get("/gordo/v0/proj/metadata-all", metadata_all)
-    app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
-    app.router.add_post(
-        "/gordo/v0/proj/{target}/anomaly/prediction", predict
-    )
-    server = TestServer(app)
-    await server.start_server()
-    try:
+    async with _stub_collection(names) as (base_url, counts):
         client = Client(
             "proj",
-            base_url=f"http://{server.host}:{server.port}",
+            base_url=base_url,
             metadata_fallback_dataset={
                 "type": "RandomDataset",
                 "tag_list": ["a", "b"],
@@ -322,8 +313,6 @@ async def test_client_prefetches_metadata_in_one_request():
             pd.Timestamp("2020-01-01 00:00:00Z"),
             pd.Timestamp("2020-01-01 02:00:00Z"),
         )
-    finally:
-        await server.close()
     assert all(r.ok for r in results), [r.error_messages for r in results]
     assert len(results) == 10
     assert counts["metadata_all"] == 1
@@ -333,37 +322,10 @@ async def test_client_prefetches_metadata_in_one_request():
 async def test_client_small_explicit_target_list_skips_prefetch():
     """A handful of explicit targets costs per-target GETs, not a
     whole-fleet metadata-all download."""
-    from aiohttp import web
-    from aiohttp.test_utils import TestServer
-
-    counts = {"metadata": 0, "metadata_all": 0}
-
-    async def metadata_all(request):
-        counts["metadata_all"] += 1
-        return web.json_response({"targets": {}})
-
-    async def metadata(request):
-        counts["metadata"] += 1
-        return web.json_response({"endpoint-metadata": {}})
-
-    async def predict(request):
-        body = await request.json()
-        return web.json_response(
-            {"data": [[0.0] * 2] * len(body["X"]), "index": body["index"]}
-        )
-
-    app = web.Application()
-    app.router.add_get("/gordo/v0/proj/metadata-all", metadata_all)
-    app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
-    app.router.add_post(
-        "/gordo/v0/proj/{target}/anomaly/prediction", predict
-    )
-    server = TestServer(app)
-    await server.start_server()
-    try:
+    async with _stub_collection(["m-0"]) as (base_url, counts):
         client = Client(
             "proj",
-            base_url=f"http://{server.host}:{server.port}",
+            base_url=base_url,
             use_parquet=False,
             metadata_fallback_dataset={
                 "type": "RandomDataset",
@@ -375,8 +337,6 @@ async def test_client_small_explicit_target_list_skips_prefetch():
             pd.Timestamp("2020-01-01 02:00:00Z"),
             targets=["m-0"],
         )
-    finally:
-        await server.close()
     assert results[0].ok, results[0].error_messages
     assert counts["metadata_all"] == 0
     assert counts["metadata"] == 1
