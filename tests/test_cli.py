@@ -112,3 +112,34 @@ class TestWorkflowGenerate:
             gordo, ["workflow", "generate", "-f", str(cfg_file), "-p", "proj"]
         )
         assert result.exit_code == EXIT_CONFIG_ERROR
+
+
+class TestClientPredictFlags:
+    @pytest.mark.parametrize(
+        "flag,expected", [("auto", "auto"), ("json", False), ("parquet", True)]
+    )
+    def test_body_encoding_maps_to_use_parquet(
+        self, runner, monkeypatch, flag, expected
+    ):
+        import gordo_components_tpu.client as client_mod
+
+        captured = {}
+
+        class FakeClient:
+            def __init__(self, project, **kwargs):
+                captured.update(kwargs, project=project)
+
+            def predict(self, start, end, targets=None):
+                return []
+
+        monkeypatch.setattr(client_mod, "Client", FakeClient)
+        result = runner.invoke(
+            gordo,
+            [
+                "--platform", "cpu", "client", "predict",
+                "2020-01-01", "2020-01-02",
+                "--project", "p", "--body-encoding", flag,
+            ],
+        )
+        assert result.exit_code == 0, result.output
+        assert captured["use_parquet"] == expected

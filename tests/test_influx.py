@@ -3,6 +3,10 @@ exists in this sandbox, so the IQL construction — quoting, escaping,
 injection resistance, URI parsing — is pinned down hard against a
 query-capturing fake client instead."""
 
+import contextlib
+import http.server
+import threading
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -311,32 +315,68 @@ class TestInfluxWirePath:
         from gordo_components_tpu.dataset.data_provider.influx_http import (
             SimpleInfluxClient,
         )
-        import http.server
-        import threading
 
-        class ErrHandler(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_GET(self):
-                payload = json.dumps(
-                    {"results": [{"error": "database not found: nope"}]}
-                ).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ErrHandler)
-        t = threading.Thread(target=srv.serve_forever, daemon=True)
-        t.start()
-        try:
+        body = {"results": [{"error": "database not found: nope"}]}
+        with _canned_http_server(body) as port:
             client = SimpleInfluxClient(
-                host="127.0.0.1", port=srv.server_address[1], database="nope"
+                host="127.0.0.1", port=port, database="nope"
             )
             with pytest.raises(RuntimeError, match="database not found"):
                 client.query("SELECT 1")
-        finally:
-            srv.shutdown()
-            srv.server_close()
+
+
+@contextlib.contextmanager
+def _canned_http_server(body_json):
+    """Serve one fixed JSON payload on every GET; yields the port."""
+    payload = json.dumps(body_json).encode()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield srv.server_address[1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_simple_client_concats_split_series():
+    """Influx can split one measurement across multiple series entries
+    (chunked responses); the client must concat them in order."""
+    from gordo_components_tpu.dataset.data_provider.influx_http import (
+        SimpleInfluxClient,
+    )
+
+    def series(ts0, vals):
+        return {
+            "name": "sensors",
+            "columns": ["time", "Value"],
+            "values": [
+                [f"2020-01-01T0{ts0 + i}:00:00Z", v] for i, v in enumerate(vals)
+            ],
+        }
+
+    body = {
+        "results": [
+            {"series": [series(0, [1.0, 2.0])]},
+            {"series": [series(2, [3.0])]},
+        ]
+    }
+    with _canned_http_server(body) as port:
+        client = SimpleInfluxClient(host="127.0.0.1", port=port)
+        out = client.query("SELECT ...")
+    df = out["sensors"]
+    assert list(df["Value"]) == [1.0, 2.0, 3.0]
+    assert df.index.tolist() == [
+        pd.Timestamp(f"2020-01-01T0{i}:00:00Z") for i in range(3)
+    ]
