@@ -41,9 +41,24 @@ class ModelCollection:
         # cross-dict consistency matters.
         self._state: tuple = ({}, {})
         self._mtimes: Dict[str, float] = {}
-        self.refresh()
+        changes = self.refresh()
         if not self.models:
-            raise FileNotFoundError(f"No model artifacts found under {root!r}")
+            detail = (
+                f"; all artifact loads failed: {changes['failed']}"
+                if changes["failed"]
+                else ""
+            )
+            raise FileNotFoundError(
+                f"No model artifacts found under {root!r}{detail}"
+            )
+        if changes["failed"]:
+            # serve the healthy subset (one corrupt artifact must not
+            # crashloop serving for the whole fleet) — but loudly: a
+            # partial startup is an operator problem, not business as usual
+            logger.error(
+                "Startup loaded %d models but %d artifacts FAILED: %s",
+                len(self.models), len(changes["failed"]), changes["failed"],
+            )
 
     @property
     def models(self) -> Dict[str, Any]:
@@ -79,19 +94,25 @@ class ModelCollection:
                 out[entry] = path
         return out
 
-    def refresh(self) -> Dict[str, list]:
+    def refresh(self) -> Dict[str, Any]:
         """Incremental rescan. Returns {"added": [...], "updated": [...],
-        "removed": [...]} by model name. Changes are staged on copies and
-        published atomically (see ``_state``); a load failure mid-refresh
-        leaves the previous consistent state serving."""
+        "removed": [...], "failed": {name: error}} by model name. Changes
+        are staged on copies and published atomically (see ``_state``).
+
+        Per-entry load isolation: a corrupt or mid-write artifact (a
+        builder racing the reload is normal in a live fleet) must not
+        block reloading everything else — the failing name is skipped
+        (its previously loaded version, if any, keeps serving), reported
+        under ``failed``, and its mtime stays unrecorded so the next
+        refresh retries it."""
         on_disk = self._scan()
         models, metadata = dict(self.models), dict(self.metadata)
         # mtimes stage on a copy too: recording them eagerly would let a
-        # load failure later in the scan mark an ALREADY-RELOADED name as
-        # current while its new model was discarded with the staged dicts
-        # — serving the stale model forever after
+        # load failure mark an ALREADY-RELOADED name as current while its
+        # new model was discarded — serving the stale model forever after
         mtimes = dict(self._mtimes)
         added, updated, removed = [], [], []
+        failed: Dict[str, str] = {}
         for name in list(models):
             if name not in on_disk:
                 removed.append(name)
@@ -101,32 +122,45 @@ class ModelCollection:
         for name, path in on_disk.items():
             try:
                 mtime = os.path.getmtime(os.path.join(path, "model.pkl"))
-            except OSError:
+            except OSError as exc:
+                # deleted between _scan() and here (builder rewriting):
+                # report it — a name silently in no bucket would hide a
+                # stale-serving model from callers watching ``failed``
+                failed[name] = f"{type(exc).__name__}: {exc}"
                 continue
-            if name not in models:
+            is_new = name not in models
+            if not is_new and mtime == mtimes.get(name):
+                continue
+            try:
                 self._load_one(models, metadata, name, path)
-                mtimes[name] = mtime
-                added.append(name)
-            elif mtime != mtimes.get(name):
-                self._load_one(models, metadata, name, path)
-                mtimes[name] = mtime
-                updated.append(name)
+            except Exception as exc:
+                logger.warning("Failed to load %r from %s: %s", name, path, exc)
+                failed[name] = f"{type(exc).__name__}: {exc}"
+                continue
+            mtimes[name] = mtime
+            (added if is_new else updated).append(name)
         self._state = (models, metadata)  # atomic publish
         self._mtimes = mtimes
-        if added or updated or removed:
+        if added or updated or removed or failed:
             logger.info(
-                "Collection refresh: +%d ~%d -%d (now %d models)",
-                len(added), len(updated), len(removed), len(models),
+                "Collection refresh: +%d ~%d -%d !%d (now %d models)",
+                len(added), len(updated), len(removed), len(failed), len(models),
             )
-        return {"added": added, "updated": updated, "removed": removed}
+        return {
+            "added": added, "updated": updated, "removed": removed,
+            "failed": failed,
+        }
 
     @staticmethod
     def _load_one(models: Dict, metadata: Dict, name: str, path: str) -> None:
         logger.info("Loading model %r from %s", name, path)
-        models[name] = serializer.load(path)
+        # assign only after BOTH loads succeed: a metadata failure must
+        # not leave a model without its metadata in the staged dicts
+        model = serializer.load(path)
         meta = serializer.load_metadata(path)
         # serve the artifact's recorded name if present
         meta.setdefault("name", name)
+        models[name] = model
         metadata[name] = meta
 
     def __contains__(self, name: str) -> bool:

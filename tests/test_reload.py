@@ -87,4 +87,48 @@ async def test_reload_updated_artifact_changes_scores(root):
 async def test_reload_noop(root):
     async with make_client(root) as client:
         body = await (await client.post("/gordo/v0/p/reload")).json()
-        assert body["changes"] == {"added": [], "updated": [], "removed": []}
+        assert body["changes"] == {
+            "added": [], "updated": [], "removed": [], "failed": {}
+        }
+
+
+async def test_reload_isolates_corrupt_artifact(root):
+    """A corrupt/mid-write artifact (builders race reloads in a live
+    fleet) must not block reloading everything else: good artifacts load,
+    the bad name is reported under failed, the previously served version
+    keeps serving, and the next reload retries it (mtime unrecorded)."""
+    import os
+    import time
+
+    async with make_client(root) as client:
+        # a good new artifact and a corrupt one land together
+        serializer.dump(_make_det(1), str(root / "m-good"), metadata={"name": "m-good"})
+        (root / "m-bad").mkdir()
+        (root / "m-bad" / "model.pkl").write_bytes(b"not a pickle")
+        resp = await client.post("/gordo/v0/proj/reload")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["changes"]["added"] == ["m-good"]
+        assert "m-bad" in body["changes"]["failed"]
+        assert set(body["models"]) == {"m-a", "m-good"}
+
+        # a corrupt UPDATE of an already-served model: stale version keeps
+        # serving rather than vanishing or 500ing the reload
+        with open(root / "m-a" / "model.pkl", "wb") as fh:
+            fh.write(b"garbage mid-write")
+        os.utime(root / "m-a" / "model.pkl", (time.time() + 5, time.time() + 5))
+        resp = await client.post("/gordo/v0/proj/reload")
+        body = await resp.json()
+        assert "m-a" in body["changes"]["failed"]
+        assert "m-a" in body["models"]
+        health = await client.get("/gordo/v0/proj/m-a/healthcheck")
+        assert health.status == 200
+
+        # fixing the artifact makes the NEXT reload pick it up (the failed
+        # load must not have recorded the new mtime)
+        serializer.dump(_make_det(2), str(root / "m-a"), metadata={"name": "m-a"})
+        resp = await client.post("/gordo/v0/proj/reload")
+        body = await resp.json()
+        assert "m-a" in body["changes"]["updated"]
+        # m-bad is still corrupt on disk and keeps being retried+reported
+        assert "m-a" not in body["changes"]["failed"]
