@@ -10,6 +10,7 @@ surface, so Ambassador-style routing by ``{target}`` still works.
 import asyncio
 import logging
 import os
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -19,6 +20,39 @@ from gordo_components_tpu.server.model_io import ModelCollection
 from gordo_components_tpu.server.views import routes
 
 logger = logging.getLogger(__name__)
+
+
+@web.middleware
+async def _stats_middleware(request, handler):
+    """Per-endpoint-kind request/error counters for ``GET .../stats``.
+    Single event-loop thread: plain dict increments are safe. Counter
+    keys come from the matched route TEMPLATE (a bounded set) — keying on
+    raw paths would let a scanner probing random URLs grow the dict
+    without bound."""
+    stats = request.app["stats"]
+    resource = getattr(request.match_info.route, "resource", None)
+    canonical = getattr(resource, "canonical", None)
+    if canonical is None:
+        kind = "other"  # unmatched route (404 scanners land here)
+    elif canonical.endswith("/anomaly/prediction"):
+        kind = "anomaly"
+    else:
+        kind = canonical.rsplit("/", 1)[-1] or "/"
+    stats["requests"][kind] = stats["requests"].get(kind, 0) + 1
+    try:
+        resp = await handler(request)
+    except web.HTTPException as exc:
+        if exc.status >= 400:
+            stats["errors"] += 1
+        raise
+    except Exception:
+        # a handler crash becomes a 500 upstream; the counter must see
+        # exactly the failures an operator most needs to
+        stats["errors"] += 1
+        raise
+    if resp.status >= 400:
+        stats["errors"] += 1
+    return resp
 
 
 def build_app(
@@ -37,7 +71,10 @@ def build_app(
     """
     if use_bank is None:
         use_bank = os.environ.get("GORDO_SERVER_BANK", "1") != "0"
-    app = web.Application(client_max_size=256 * 1024**2)
+    app = web.Application(
+        client_max_size=256 * 1024**2, middlewares=[_stats_middleware]
+    )
+    app["stats"] = {"started_at": time.time(), "requests": {}, "errors": 0}
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
     app["bank_enabled"] = use_bank

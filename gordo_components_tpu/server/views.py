@@ -17,6 +17,7 @@ stays responsive while XLA executes.
 import asyncio
 import json
 import logging
+import time
 from typing import Any
 
 import numpy as np
@@ -97,6 +98,33 @@ async def list_models(request: web.Request) -> web.Response:
     bank = _bank_coverage(request, body["models"])
     if bank is not None:
         body["bank"] = bank
+    return web.json_response(body)
+
+
+@routes.get("/gordo/v0/{project}/stats")
+async def server_stats(request: web.Request) -> web.Response:
+    """Serving-process observability (SURVEY.md §5 metrics): request
+    counters by endpoint kind, error count, uptime, and the continuous
+    -batching engine's coalescing effectiveness (avg rolled-up batch
+    size is THE number that explains bank throughput)."""
+    stats = request.app.get("stats") or {}
+    body: Any = {
+        "uptime_seconds": round(
+            time.time() - stats.get("started_at", time.time()), 1
+        ),
+        "requests": dict(stats.get("requests", {})),
+        "errors": int(stats.get("errors", 0)),
+        "models": len(_collection(request).models),
+    }
+    engine = request.app.get("bank_engine")
+    if engine is not None:
+        es = dict(engine.stats)
+        if es.get("batches"):
+            es["avg_batch"] = round(es["requests"] / es["batches"], 2)
+        body["bank_engine"] = es
+    bank = request.app.get("bank")
+    if bank is not None:
+        body["bank_models"] = len(bank)
     return web.json_response(body)
 
 

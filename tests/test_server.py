@@ -76,6 +76,36 @@ async def test_metadata_all(artifact_dir):
         assert "machine-b" in body["bank"]["fallback"]
 
 
+async def test_server_stats(artifact_dir):
+    """GET /stats reports per-endpoint request counters, errors, uptime,
+    and the batching engine's coalescing stats."""
+    async with make_client(artifact_dir) as client:
+        await client.get("/gordo/v0/proj/models")
+        await client.get("/gordo/v0/proj/machine-a/healthcheck")
+        await client.get("/gordo/v0/proj/ghost/healthcheck")  # 404 -> errors
+        await client.post(
+            "/gordo/v0/proj/machine-a/anomaly/prediction", json=_x_payload()
+        )
+        # scanner probes with unbounded distinct paths must collapse into
+        # ONE "other" bucket, not one counter key per probed URL
+        await client.get("/admin.php")
+        await client.get("/nonsense-123")
+        resp = await client.get("/gordo/v0/proj/stats")
+        assert resp.status == 200
+        body = await resp.json()
+    assert body["uptime_seconds"] >= 0
+    assert body["requests"]["models"] == 1
+    assert body["requests"]["healthcheck"] == 2
+    assert body["requests"]["anomaly"] == 1
+    assert body["requests"]["other"] == 2
+    assert "admin.php" not in body["requests"]
+    assert body["errors"] == 3  # ghost 404 + two unmatched probes
+    assert body["models"] == 2
+    # machine-a banks, so the engine coalescing stats must surface
+    assert body["bank_engine"]["requests"] >= 1
+    assert body["bank_engine"]["avg_batch"] >= 1
+
+
 async def test_healthcheck_and_404(artifact_dir):
     async with make_client(artifact_dir) as client:
         resp = await client.get("/gordo/v0/proj/machine-a/healthcheck")
