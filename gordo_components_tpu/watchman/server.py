@@ -87,6 +87,27 @@ class WatchmanState:
 
         return await fetch_metadata_all(session, self.base_url, self.project)
 
+    async def _fetch_stats(self, session) -> Optional[Dict[str, Any]]:
+        """Serving-load counters from the collection's ``/stats`` — a
+        best-effort decoration (collection servers only; foreign servers
+        simply lack it) so operators see request/coalescing load next to
+        fleet health."""
+
+        async def get():
+            async with session.get(
+                f"{self.base_url}/gordo/v0/{self.project}/stats"
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+
+        try:
+            body = await asyncio.wait_for(get(), timeout=10.0)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
+            logger.debug("stats fetch failed: %s", exc)
+            return None
+        return body if isinstance(body, dict) else None
+
     async def snapshot(self) -> Dict[str, Any]:
         async with self._lock:
             now = time.monotonic()
@@ -97,10 +118,16 @@ class WatchmanState:
             async with aiohttp.ClientSession(timeout=timeout) as session:
                 batched = await self._fetch_metadata_all(session)
                 if batched is not None:
-                    endpoints, bank = await self._snapshot_from_batched(
-                        session, sem, batched
+                    # stats is decoration-only: fetch it CONCURRENTLY with
+                    # the endpoint assembly so a slow /stats can't add its
+                    # deadline to every cache refresh held under the lock
+                    (endpoints, bank), stats = await asyncio.gather(
+                        self._snapshot_from_batched(session, sem, batched),
+                        self._fetch_stats(session),
                     )
-                    return await self._finish_snapshot(endpoints, bank, now)
+                    return await self._finish_snapshot(
+                        endpoints, bank, now, stats
+                    )
                 # /models carries both the target list and the HBM bank
                 # coverage (which models score from the stacked bank vs
                 # the per-model fallback, and why) — fetched even with an
@@ -185,7 +212,11 @@ class WatchmanState:
         return [by_target[t] for t in targets], batched.get("bank")
 
     async def _finish_snapshot(
-        self, endpoints: List[Dict[str, Any]], bank, now: float
+        self,
+        endpoints: List[Dict[str, Any]],
+        bank,
+        now: float,
+        stats: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Shared snapshot tail: bank-coverage annotation, gang heartbeat
         aggregation, cache commit. Runs under ``self._lock``."""
@@ -208,6 +239,8 @@ class WatchmanState:
         }
         if bank is not None:
             self._cache["bank"] = bank
+        if stats is not None:
+            self._cache["server-stats"] = stats
         if self.gang_state_dir:
             from gordo_components_tpu.workflow.gang_state import read_gang_states
 

@@ -569,3 +569,28 @@ def test_lstm_fleet_members_bank_and_score(lstm_fleet):
     expected = dets["m1"].anomaly(X)
     got = bank.score("m1", X).to_frame()
     pd.testing.assert_frame_equal(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_quantile_fleet_artifact_round_trips(tmp_path):
+    """A quantile-threshold sequence fleet member must survive the full
+    artifact cycle: to_estimator -> serializer.dump -> load -> anomaly,
+    with the streamed thresholds and quantile knob intact."""
+    from gordo_components_tpu import serializer
+
+    members = _seq_members(1, rows=64)
+    (fm,) = FleetTrainer(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(8,),
+        lookback_window=LOOKBACK, epochs=1, batch_size=32, seed=0,
+        threshold_quantile=0.9,
+    ).fit(members).values()
+    det = fm.to_estimator()
+    serializer.dump(det, str(tmp_path / "art"), metadata={"name": "m0"})
+    loaded = serializer.load(str(tmp_path / "art"))
+    assert loaded.threshold_quantile == 0.9
+    np.testing.assert_array_equal(
+        loaded.feature_thresholds_, fm.feature_thresholds
+    )
+    assert loaded.total_threshold_ == fm.total_threshold
+    frame = loaded.anomaly(members["m0"])
+    assert ("total-anomaly-scaled", "") in frame.columns
+    assert len(frame) == members["m0"].shape[0] - LOOKBACK + 1
