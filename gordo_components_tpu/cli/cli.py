@@ -251,10 +251,21 @@ def client_metadata(project, base_url):
 
     import aiohttp
 
-    from gordo_components_tpu.client.io import fetch_json
+    from gordo_components_tpu.client.io import fetch_json, fetch_metadata_all
 
     async def go():
         async with aiohttp.ClientSession() as session:
+            # one metadata-all request against a collection server;
+            # per-target fetches only for foreign servers
+            batched = await fetch_metadata_all(session, base_url, project)
+            if batched is not None:
+                return {
+                    name: entry.get("endpoint-metadata", {})
+                    for name, entry in batched["targets"].items()
+                    # a catch-all proxy can pass the shape check with
+                    # non-dict entries; skip them rather than crash
+                    if isinstance(entry, dict)
+                }
             targets = (
                 await fetch_json(session, f"{base_url}/gordo/v0/{project}/models")
             )["models"]
