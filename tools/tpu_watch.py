@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""TPU tunnel watcher (VERDICT r3 next #1a).
+
+The tunneled TPU on this build box wedges for hours at a time (rounds 2-4:
+the 'tpu' pin fails fast with "No jellyfish device found" while default
+backend resolution hangs in a socket recv). The driver's end-of-round bench
+has therefore never seen the chip. This watcher closes that hole from the
+builder side: it probes the tunnel every few minutes for the whole session,
+and the moment a full host->device->compute->fetch round trip succeeds it
+runs ``bench.py --quick`` (headline in ~2 min, in case the window is
+narrow) and then the full ``bench.py`` — each of which auto-writes a
+fingerprinted ``BENCH_TPU_<ts>.json`` artifact for the record.
+
+Probe order is pin-first: the 'tpu' pin fails FAST when the tunnel is down
+(~3 s) while the default flavor burns its full timeout hanging, so pin
+first makes the idle loop cheap. Probes and benches run in subprocesses
+under hard timeouts — no in-process recovery exists for a wedged data
+plane (see bench.probe_backend).
+
+Usage: python tools/tpu_watch.py  (blocks; exits 0 after a capture,
+3 on deadline with no TPU). Env knobs: TPU_WATCH_INTERVAL_S (default 240),
+TPU_WATCH_MAX_H (default 11), TPU_WATCH_SKIP_FULL=1 (quick only).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+sys.path.insert(0, REPO)
+
+from bench import _probe_once  # noqa: E402  (the one canonical probe)
+
+
+def probe_once(pin: str | None, timeout: float):
+    """One compute-round-trip probe via bench's canonical subprocess probe.
+
+    Returns (platform-or-None, note). A non-cpu platform means the full
+    host->device->compute->fetch path answered; cpu resolution and every
+    failure mode map to (None, reason).
+    """
+    platform, kind, n, err = _probe_once(pin, timeout)
+    if platform is not None and platform != "cpu":
+        return platform, f"{platform}/{kind} x{n}"
+    if platform == "cpu":
+        return None, "cpu-only"
+    return None, err or "?"
+
+
+def run_bench(args, timeout):
+    env = dict(os.environ, GRAFT_BENCH_PROBE_BUDGET_S="240")
+    t0 = time.time()
+    # own session: bench spawns --child grandchildren, and a timeout kill
+    # of the supervisor alone would orphan a runner that keeps the TPU
+    # busy forever — kill the whole process group instead
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        # collect what the child managed to print before the kill: a
+        # TPU_ARTIFACT line may already be there (and the file on disk)
+        stdout, _ = proc.communicate()
+        stdout = (stdout or "") + "\n(bench timed out)"
+        rc = -1
+    tail = stdout.strip().splitlines()
+    log(f"bench {' '.join(args) or '(full)'}: rc={rc} in {time.time()-t0:.0f}s")
+    for line in tail[-2:]:
+        log(f"  {line[:300]}")
+    # bench prints TPU_ARTIFACT only when the headline fleet metric itself
+    # ran on the accelerator (not on the post-wedge CPU fallback); the
+    # parsed path identifies exactly what THIS run captured
+    return [
+        l.split(" ", 1)[1] for l in tail if l.startswith("TPU_ARTIFACT ")
+    ]
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def main():
+    interval = float(os.environ.get("TPU_WATCH_INTERVAL_S", 240))
+    deadline = time.time() + 3600 * float(os.environ.get("TPU_WATCH_MAX_H", 11))
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        # pin-first: fails in ~3s when the tunnel is down; the default
+        # flavor would hang its whole timeout, so it only runs second
+        platform, note = probe_once("tpu", 90)
+        if platform is None:
+            # always try BOTH flavors: the pin failing (even by timeout)
+            # says nothing about default resolution — the two layers have
+            # wedged independently across rounds
+            platform, note2 = probe_once(None, 120)
+            note = f"pin: {note}; default: {note2}" if platform is None else note2
+        if platform is None:
+            log(f"probe {attempt}: no accelerator ({note})")
+            time.sleep(interval)
+            continue
+        log(f"probe {attempt}: LIVE {note} — capturing bench artifacts")
+        arts = run_bench(["--quick"], timeout=1200)
+        # only attempt the hour-long full suite when the quick run proved
+        # the window is real; otherwise re-arm the probe loop promptly
+        if arts and os.environ.get("TPU_WATCH_SKIP_FULL") != "1":
+            arts += run_bench([], timeout=3600)
+        if arts:
+            log(f"captured: {json.dumps(arts)}")
+            return 0
+        log("tunnel answered the probe but wedged during bench; re-arming")
+        time.sleep(interval)
+    log("deadline reached with no TPU capture")
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
