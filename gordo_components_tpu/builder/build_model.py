@@ -97,6 +97,24 @@ def _wants_cv(evaluation_config: Dict[str, Any]) -> bool:
     return wants and int(evaluation_config.get("n_splits", 3)) > 0
 
 
+def cached_cv_satisfied(cached_dir: str, evaluation: Dict[str, Any]) -> bool:
+    """True iff ``cached_dir``'s artifact satisfies ``evaluation`` (already
+    normalized): either no CV is requested, or the recorded per-fold scores
+    match the requested fold count. The ONE cache-satisfaction contract —
+    single builds (provide_saved_model) and gang reruns (fleet_build) must
+    hit/miss the registry identically for the same machine."""
+    if not _wants_cv(evaluation):
+        return True
+    folds = (
+        serializer.load_metadata(cached_dir)
+        .get("model", {})
+        .get("cross-validation", {})
+        .get("explained-variance", {})
+        .get("per-fold", [])
+    )
+    return len(folds) == int(evaluation.get("n_splits", 3))
+
+
 def _pipeline_metadata(model) -> Dict[str, Any]:
     """Metadata for sklearn Pipelines wrapping our estimators."""
     if hasattr(model, "steps"):
@@ -192,20 +210,9 @@ def provide_saved_model(
     if model_register_dir and not replace_cache and not cross_val_only:
         cached = os.path.join(model_register_dir, cache_key)
         if os.path.isdir(cached) and os.path.exists(os.path.join(cached, "model.pkl")):
-            if _wants_cv(evaluation):
-                # the cached CV must match the requested fold count, or the
-                # hit would report stats for a CV the caller didn't ask for
-                folds = (
-                    serializer.load_metadata(cached)
-                    .get("model", {})
-                    .get("cross-validation", {})
-                    .get("explained-variance", {})
-                    .get("per-fold", [])
-                )
-                cv_satisfied = len(folds) == int(evaluation.get("n_splits", 3))
-            else:
-                cv_satisfied = True
-            if cv_satisfied:
+            # the cached CV must match the requested fold count, or the
+            # hit would report stats for a CV the caller didn't ask for
+            if cached_cv_satisfied(cached, evaluation):
                 logger.info("Model %s found in build cache: %s", name, cached)
                 _mirror_artifact(cached, output_dir)
                 return cached

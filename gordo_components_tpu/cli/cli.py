@@ -64,8 +64,14 @@ def _load_json_or_yaml(value: str):
 @click.option("--metadata", envvar="METADATA", default="{}")
 @click.option("--output-dir", envvar="OUTPUT_DIR", default="./model-output")
 @click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
+@click.option("--evaluation-config", envvar="EVALUATION_CONFIG", default="{}",
+              help="JSON/YAML evaluation block (env EVALUATION_CONFIG): "
+                   '{"cv_mode": "full_build"|"cross_val_only", '
+                   '"cross_validation": true, "n_splits": 3} — '
+                   "TimeSeriesSplit CV scores land in artifact metadata")
 @click.option("--print-cv-scores", is_flag=True)
-def build(name, model_config, data_config, metadata, output_dir, model_register_dir, print_cv_scores):
+def build(name, model_config, data_config, metadata, output_dir,
+          model_register_dir, evaluation_config, print_cv_scores):
     """Build one model (builder-pod entrypoint; reference §3.1)."""
     from gordo_components_tpu import serializer
     from gordo_components_tpu.builder import provide_saved_model
@@ -74,6 +80,7 @@ def build(name, model_config, data_config, metadata, output_dir, model_register_
         model_config = _load_json_or_yaml(model_config)
         data_config = _load_json_or_yaml(data_config)
         metadata = _load_json_or_yaml(metadata) or {}
+        evaluation_config = _load_json_or_yaml(evaluation_config) or {}
     except yaml.YAMLError as exc:
         click.echo(f"Config parse error: {exc}", err=True)
         sys.exit(EXIT_CONFIG_ERROR)
@@ -82,6 +89,7 @@ def build(name, model_config, data_config, metadata, output_dir, model_register_
         path = provide_saved_model(
             name, model_config, data_config, metadata,
             output_dir=output_dir, model_register_dir=model_register_dir,
+            evaluation_config=evaluation_config,
         )
     except (ValueError, ImportError, FileNotFoundError) as exc:
         click.echo(f"Build failed (config/data): {exc}", err=True)
@@ -135,6 +143,7 @@ def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_di
             name=e["name"],
             dataset=e.get("dataset", {}),
             metadata=e.get("metadata", {}) or {},
+            evaluation=e.get("evaluation", {}) or {},
         )
         if e.get("model"):  # absent -> Machine's default model config
             kwargs["model"] = e["model"]

@@ -83,6 +83,7 @@ class NormalizedConfig:
         globals:                   # optional project defaults
           model: {...}
           dataset: {...}
+          evaluation: {...}        # CV defaults merged into every machine
           runtime: {...}           # TPU gang-scheduling knobs (see scheduler)
     """
 
@@ -98,6 +99,7 @@ class NormalizedConfig:
             DEFAULT_DATASET_CONFIG, globals_.get("dataset", {}) or {}
         )
         default_metadata = globals_.get("metadata", {}) or {}
+        default_evaluation = globals_.get("evaluation", {}) or {}
         self.runtime: Dict[str, Any] = globals_.get("runtime", {}) or {}
 
         self.machines: List[Machine] = []
@@ -118,7 +120,9 @@ class NormalizedConfig:
                     else copy.deepcopy(default_model)
                 ),
                 metadata=_deep_merge(default_metadata, entry.get("metadata", {}) or {}),
-                evaluation=copy.deepcopy(entry.get("evaluation", {}) or {}),
+                evaluation=_deep_merge(
+                    default_evaluation, entry.get("evaluation", {}) or {}
+                ),
             )
             self.machines.append(machine)
 

@@ -47,6 +47,24 @@ class TestBuild:
         assert result.exit_code == 0, result.output
         assert os.path.exists(tmp_path / "out" / "model.pkl")
 
+    def test_build_evaluation_config_prints_cv_scores(self, runner, tmp_path):
+        """EVALUATION_CONFIG (env) reaches provide_saved_model and
+        --print-cv-scores emits the recorded per-fold scores
+        (VERDICT r3 next #2: the flag used to print {} always)."""
+        env = {
+            "MACHINE_NAME": "m1",
+            "MODEL_CONFIG": MODEL_CONFIG,
+            "DATA_CONFIG": DATA_CONFIG,
+            "OUTPUT_DIR": str(tmp_path / "out"),
+            "EVALUATION_CONFIG": json.dumps(
+                {"cross_validation": True, "n_splits": 2}
+            ),
+        }
+        result = runner.invoke(gordo, ["build", "--print-cv-scores"], env=env)
+        assert result.exit_code == 0, result.output
+        scores = json.loads(result.output.strip().splitlines()[0])
+        assert len(scores["per-fold"]) == 2
+
     def test_build_bad_config_exit_code(self, runner, tmp_path):
         env = {
             "MACHINE_NAME": "m1",
@@ -79,6 +97,35 @@ class TestBuildFleet:
         assert result.exit_code == 0, result.output
         assert os.path.exists(tmp_path / "out" / "m1" / "model.pkl")
         assert os.path.exists(tmp_path / "out" / "m2" / "model.pkl")
+
+    def test_build_fleet_carries_evaluation(self, runner, tmp_path):
+        """Machine-level evaluation blocks in the gang payload survive the
+        CLI round-trip into CV metadata on the artifact."""
+        from gordo_components_tpu import serializer
+
+        payload = {
+            "machines": [
+                {
+                    "name": "m1",
+                    "dataset": json.loads(DATA_CONFIG),
+                    "evaluation": {"cross_validation": True, "n_splits": 2},
+                }
+            ]
+        }
+        machines_file = tmp_path / "machines.json"
+        machines_file.write_text(json.dumps(payload))
+        result = runner.invoke(
+            gordo,
+            [
+                "build-fleet",
+                "--machines-file", str(machines_file),
+                "--output-dir", str(tmp_path / "out"),
+            ],
+        )
+        assert result.exit_code == 0, result.output
+        md = serializer.load_metadata(str(tmp_path / "out" / "m1"))
+        ev = md["model"]["cross-validation"]["explained-variance"]
+        assert len(ev["per-fold"]) == 2
 
 
 class TestWorkflowGenerate:
