@@ -195,16 +195,21 @@ def run_server_cmd(model_dir, host, port):
               help="JSON list; discovered from the server when omitted")
 @click.option("--gang-state-dir", envvar="GANG_STATE_DIR", default=None,
               help="Aggregate builder-gang heartbeats from this directory")
+@click.option("--full-metadata", is_flag=True, envvar="WATCHMAN_FULL_METADATA",
+              help="Aggregate FULL per-target metadata instead of the "
+                   "bounded digest (digest keeps 10k-fleet snapshots under "
+                   "~1 MB; full restores the reference-style aggregate)")
 @click.option("--host", default="0.0.0.0")
 @click.option("--port", default=5556, type=int)
-def run_watchman_cmd(project, server_base_url, targets, gang_state_dir, host, port):
+def run_watchman_cmd(project, server_base_url, targets, gang_state_dir,
+                     full_metadata, host, port):
     """Fleet health aggregation service."""
     from gordo_components_tpu.watchman import run_watchman
 
     target_list = json.loads(targets) if targets else None
     run_watchman(
         project, server_base_url, target_list, host=host, port=port,
-        gang_state_dir=gang_state_dir,
+        gang_state_dir=gang_state_dir, full_metadata=full_metadata,
     )
 
 

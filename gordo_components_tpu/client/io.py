@@ -23,9 +23,14 @@ async def fetch_metadata_all(
     base_url: str,
     project: str,
     deadline: float = 10.0,
+    digest: bool = False,
 ) -> Optional[Dict[str, Any]]:
     """One-request control-plane snapshot from the collection server's
     ``metadata-all`` endpoint, shared by watchman and the bulk client.
+
+    ``digest=True`` asks for the bounded per-target digest instead of
+    full metadata (watchman's polling default; the bulk client needs the
+    full dataset configs and never sets it).
 
     Best-effort by contract: returns the validated body (a dict with a
     dict ``targets``) or None on non-200, timeout, or malformed/foreign
@@ -33,10 +38,11 @@ async def fetch_metadata_all(
     matters because this runs serially BEFORE the fallback: a foreign
     endpoint that accepts the connection but hangs must not stall the
     caller by the full session timeout (or fetch retries)."""
+    suffix = "?digest=1" if digest else ""
 
     async def get():
         async with session.get(
-            f"{base_url.rstrip('/')}/gordo/v0/{project}/metadata-all"
+            f"{base_url.rstrip('/')}/gordo/v0/{project}/metadata-all{suffix}"
         ) as resp:
             if resp.status != 200:
                 return None

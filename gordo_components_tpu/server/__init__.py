@@ -17,6 +17,7 @@ from aiohttp import web
 
 from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 from gordo_components_tpu.server.model_io import ModelCollection
+from gordo_components_tpu.server.stats import LatencyHistogram
 from gordo_components_tpu.server.views import routes
 
 logger = logging.getLogger(__name__)
@@ -24,11 +25,11 @@ logger = logging.getLogger(__name__)
 
 @web.middleware
 async def _stats_middleware(request, handler):
-    """Per-endpoint-kind request/error counters for ``GET .../stats``.
-    Single event-loop thread: plain dict increments are safe. Counter
-    keys come from the matched route TEMPLATE (a bounded set) — keying on
-    raw paths would let a scanner probing random URLs grow the dict
-    without bound."""
+    """Per-endpoint-kind request/error counters + service-time histograms
+    for ``GET .../stats``. Single event-loop thread: plain dict/int
+    mutation is safe. Counter keys come from the matched route TEMPLATE
+    (a bounded set) — keying on raw paths would let a scanner probing
+    random URLs grow the dict without bound."""
     stats = request.app["stats"]
     resource = getattr(request.match_info.route, "resource", None)
     canonical = getattr(resource, "canonical", None)
@@ -39,6 +40,10 @@ async def _stats_middleware(request, handler):
     else:
         kind = canonical.rsplit("/", 1)[-1] or "/"
     stats["requests"][kind] = stats["requests"].get(kind, 0) + 1
+    hist = stats["latency"].get(kind)
+    if hist is None:
+        hist = stats["latency"][kind] = LatencyHistogram()
+    t0 = time.monotonic()
     try:
         resp = await handler(request)
     except web.HTTPException as exc:
@@ -50,6 +55,10 @@ async def _stats_middleware(request, handler):
         # exactly the failures an operator most needs to
         stats["errors"] += 1
         raise
+    finally:
+        # errored requests count too: a timeout-then-500 pattern is
+        # exactly what a tail-latency histogram exists to surface
+        hist.record(time.monotonic() - t0)
     if resp.status >= 400:
         stats["errors"] += 1
     return resp
@@ -74,7 +83,12 @@ def build_app(
     app = web.Application(
         client_max_size=256 * 1024**2, middlewares=[_stats_middleware]
     )
-    app["stats"] = {"started_at": time.time(), "requests": {}, "errors": 0}
+    app["stats"] = {
+        "started_at": time.time(),
+        "requests": {},
+        "errors": 0,
+        "latency": {},
+    }
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
     app["bank_enabled"] = use_bank

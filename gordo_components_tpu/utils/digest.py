@@ -1,0 +1,52 @@
+"""Control-plane metadata digest.
+
+Reference parity note: the reference's watchman carried each model's FULL
+metadata in its aggregate (gordo_components/watchman, unverified;
+SURVEY.md §2 "watchman") — fine at one pod per model, but a 10k-model
+collection snapshot with per-epoch training histories is a multi-MB JSON
+encode on the serving process every refresh interval, forever (VERDICT r3
+next #5). The digest is the O(small)-bytes answer: the handful of fields
+an operator's fleet dashboard actually keys on, with full metadata still
+served per-target (and by ``metadata-all`` without ``digest=1``).
+"""
+
+from typing import Any, Dict
+
+__all__ = ["metadata_digest"]
+
+
+def metadata_digest(md: Dict[str, Any]) -> Dict[str, Any]:
+    """Flat, bounded-size summary of one artifact's endpoint metadata.
+
+    Tolerates foreign/partial metadata shapes: every field degrades to
+    None/absent rather than raising, because watchman also digests
+    metadata fetched from non-collection servers.
+    """
+    md = md if isinstance(md, dict) else {}
+    model = md.get("model") or {}
+    if not isinstance(model, dict):
+        model = {}
+    cfg = model.get("model_config")
+    dataset = md.get("dataset") or {}
+    tags = dataset.get("tag_list") if isinstance(dataset, dict) else None
+    digest: Dict[str, Any] = {
+        "name": md.get("name"),
+        "checked_at": md.get("checked_at"),
+        # the dotted path of the pipeline root identifies the model family
+        "model": next(iter(cfg), None) if isinstance(cfg, dict) else None,
+        "cache_key": model.get("model_builder_cache_key"),
+        "n_tags": len(tags) if isinstance(tags, (list, tuple)) else None,
+        "trained": model.get("trained"),
+    }
+    # absent fields are dropped, not spelled out as nulls: foreign/minimal
+    # metadata must digest SMALLER than itself, and at 10k targets every
+    # null key is dead wire bytes
+    digest = {k: v for k, v in digest.items() if v is not None}
+    if model.get("fleet_trained"):
+        digest["fleet_trained"] = True
+    cv = model.get("cross-validation")
+    if isinstance(cv, dict):
+        ev = cv.get("explained-variance")
+        if isinstance(ev, dict) and "mean" in ev:
+            digest["cv_mean_explained_variance"] = ev["mean"]
+    return digest

@@ -38,12 +38,19 @@ class WatchmanState:
         parallelism: int = 20,
         gang_state_dir: Optional[str] = None,
         gang_stale_after: float = 120.0,
+        full_metadata: bool = False,
     ):
         self.project = project
         self.base_url = base_url.rstrip("/")
         self.targets = targets
         self.refresh_interval = refresh_interval
         self.parallelism = parallelism
+        # digest polling by default (VERDICT r3 next #5): a 10k-model
+        # snapshot with per-epoch training histories is tens of MB of JSON
+        # encoded on the SERVING process every refresh; the digest keeps
+        # the control plane O(small) bytes. full_metadata restores the
+        # reference-style full aggregate on request.
+        self.full_metadata = bool(full_metadata)
         # builder-side failure detection: aggregate gang heartbeats from
         # the shared state volume (workflow/gang_state.py) so a stalled or
         # failed TPU gang is visible next to serving health
@@ -70,7 +77,19 @@ class WatchmanState:
                     async with session.get(self._url(target, "metadata")) as resp:
                         if resp.status == 200:
                             body = await resp.json()
-                            entry["endpoint-metadata"] = body.get("endpoint-metadata", {})
+                            meta = body.get("endpoint-metadata", {})
+                            if self.full_metadata:
+                                entry["endpoint-metadata"] = meta
+                            else:
+                                # foreign servers only speak full metadata;
+                                # digest locally so the snapshot shape is
+                                # uniform across the batched and fallback
+                                # paths
+                                from gordo_components_tpu.utils.digest import (
+                                    metadata_digest,
+                                )
+
+                                entry["digest"] = metadata_digest(meta)
             except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
                 logger.warning("healthcheck failed for %s: %s", target, exc)
         return entry
@@ -85,7 +104,10 @@ class WatchmanState:
         client/io.py::fetch_metadata_all)."""
         from gordo_components_tpu.client.io import fetch_metadata_all
 
-        return await fetch_metadata_all(session, self.base_url, self.project)
+        return await fetch_metadata_all(
+            session, self.base_url, self.project,
+            digest=not self.full_metadata,
+        )
 
     async def _fetch_stats(self, session) -> Optional[Dict[str, Any]]:
         """Serving-load counters from the collection's ``/stats`` — a
@@ -199,8 +221,9 @@ class WatchmanState:
                     "target": t,
                     "healthy": bool(tmap[t].get("healthy", False)),
                 }
-                if "endpoint-metadata" in tmap[t]:
-                    entry["endpoint-metadata"] = tmap[t]["endpoint-metadata"]
+                for key in ("endpoint-metadata", "digest"):
+                    if key in tmap[t]:
+                        entry[key] = tmap[t][key]
                 by_target[t] = entry
             else:
                 missing.append(t)
@@ -261,10 +284,11 @@ def build_watchman_app(
     targets: Optional[List[str]] = None,
     refresh_interval: float = 30.0,
     gang_state_dir: Optional[str] = None,
+    full_metadata: bool = False,
 ) -> web.Application:
     state = WatchmanState(
         project, base_url, targets, refresh_interval,
-        gang_state_dir=gang_state_dir,
+        gang_state_dir=gang_state_dir, full_metadata=full_metadata,
     )
     app = web.Application()
     app["state"] = state
@@ -288,11 +312,12 @@ def run_watchman(
     port: int = 5556,
     refresh_interval: float = 30.0,
     gang_state_dir: Optional[str] = None,
+    full_metadata: bool = False,
 ) -> None:
     web.run_app(
         build_watchman_app(
             project, base_url, targets, refresh_interval,
-            gang_state_dir=gang_state_dir,
+            gang_state_dir=gang_state_dir, full_metadata=full_metadata,
         ),
         host=host,
         port=port,

@@ -39,8 +39,22 @@ async def test_watchman_aggregates_health_and_metadata(collection_dir, live_serv
     assert set(by_target) == {"m-1", "m-2"}
     for name, entry in by_target.items():
         assert entry["healthy"] is True
-        assert entry["endpoint-metadata"]["name"] == name
+        # digest polling is the default (VERDICT r3 #5): bounded fields,
+        # no training histories
+        assert entry["digest"]["name"] == name
+        assert "endpoint-metadata" not in entry
         assert entry["endpoint"] == f"/gordo/v0/proj/{name}/"
+
+
+async def test_watchman_full_metadata_mode(collection_dir, live_server):
+    """full_metadata restores the reference-style full aggregate."""
+    async with live_server(collection_dir) as base_url:
+        body = await WatchmanState(
+            "proj", base_url, full_metadata=True
+        ).snapshot()
+    for entry in body["endpoints"]:
+        assert entry["endpoint-metadata"]["name"] == entry["target"]
+        assert "digest" not in entry
 
 
 async def test_watchman_aggregates_bank_coverage(collection_dir, live_server):
@@ -196,6 +210,9 @@ async def test_watchman_falls_back_per_target_without_batched_endpoint():
     by_target = {e["target"]: e for e in body["endpoints"]}
     assert set(by_target) == set(names)
     assert all(e["healthy"] for e in by_target.values())
+    # foreign servers only speak full metadata; the fallback digests it
+    # locally so the snapshot shape stays uniform
+    assert all(e["digest"]["name"] == t for t, e in by_target.items())
     # 1 failed metadata-all + 1 models + 2 per target
     assert counts["total"] == 2 + 2 * len(names)
 
