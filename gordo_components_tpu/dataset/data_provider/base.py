@@ -16,6 +16,13 @@ from gordo_components_tpu.dataset.sensor_tag import SensorTag
 
 
 class GordoBaseDataProvider(abc.ABC):
+    # staging-engine hint (utils/staging.py): True when load_series spends
+    # its time waiting on IO (network/object stores), so thread pools
+    # overlap even on one core; False for pure host-compute providers,
+    # where threads only add GIL contention. Default True — real data
+    # comes over a wire.
+    io_bound = True
+
     @abc.abstractmethod
     def load_series(
         self,

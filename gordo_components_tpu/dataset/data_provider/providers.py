@@ -38,6 +38,8 @@ class RandomDataProvider(GordoBaseDataProvider):
     frequency/phase/amplitude derived from a hash of the tag name) plus
     gaussian noise, sampled at ``freq``."""
 
+    io_bound = False  # pure host compute: no wire to overlap on
+
     @capture_args
     def __init__(self, freq: str = "1min", noise: float = 0.1, seed: int = 0):
         self.freq = freq

@@ -178,11 +178,12 @@ async def metadata_all(request: web.Request) -> web.Response:
     if bank is not None:
         body["bank"] = bank
     resp = web.json_response(body)
-    if want_digest:
-        # digest bodies are highly repetitive JSON (same keys per target);
-        # gzip takes a 10k-fleet snapshot from a few MB to a few hundred
-        # KB on the wire for clients that accept it
-        resp.enable_compression()
+    # metadata-all bodies are highly repetitive JSON (same keys per
+    # target); gzip takes a 10k-fleet digest snapshot from a few MB to a
+    # few hundred KB — and the FULL body from tens of MB — on the wire
+    # for clients that accept it (aiohttp only compresses when the client
+    # sent Accept-Encoding)
+    resp.enable_compression()
     return resp
 
 
