@@ -27,6 +27,7 @@ from gordo_components_tpu.builder.build_model import (
     provide_saved_model,
 )
 from gordo_components_tpu.parallel.fleet import (
+    DEFAULT_LEARNING_RATE,
     FleetTrainer,
     _family_defaults,
     _target_offset_for,
@@ -194,7 +195,35 @@ def _estimator_kwargs(defn) -> Optional[Tuple[str, Dict[str, Any]]]:
 
 
 def _group_key(ae_kwargs: Dict[str, Any]) -> Tuple:
-    return tuple(sorted((k, repr(v)) for k, v in ae_kwargs.items()))
+    """Gang membership key. ``learning_rate`` and (the VALUE of)
+    ``early_stopping_patience`` are excluded: FleetTrainer stacks them as
+    per-member (M,) vectors inside one program (VERDICT r3 next #7 /
+    SURVEY §7 hard part 4), so machines differing only in those knobs
+    must share a gang instead of shrinking vmap width. ES *presence*
+    still splits — ES-on and ES-off members run different programs."""
+    items = []
+    for k, v in sorted(ae_kwargs.items()):
+        if k == "learning_rate":
+            continue
+        if k == "early_stopping_patience":
+            items.append((k, v is not None))
+            continue
+        items.append((k, repr(v)))
+    return tuple(items)
+
+
+def _member_hparams_of(ae_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """The per-member vector knobs, with omissions normalized to the
+    ENGINE defaults — a machine that omitted learning_rate must train at
+    the default, not at whichever rate the group's first machine chose."""
+    hp = {
+        "learning_rate": float(
+            ae_kwargs.get("learning_rate", DEFAULT_LEARNING_RATE)
+        )
+    }
+    if ae_kwargs.get("early_stopping_patience") is not None:
+        hp["early_stopping_patience"] = int(ae_kwargs["early_stopping_patience"])
+    return hp
 
 
 # CV fold members ride the SAME stacked member axis as real members — the
@@ -459,7 +488,8 @@ def _build_fleet_group(
     # (a CV-requesting machine only hits if the artifact records matching
     # per-fold scores, mirroring provide_saved_model)
     pending: List[Machine] = []
-    for machine, _ in group:
+    pending_kwargs: Dict[str, Dict[str, Any]] = {}
+    for machine, kw in group:
         key = calculate_model_key(machine.name, machine.model, machine.dataset, machine.metadata)
         if model_register_dir and not replace_cache:
             cached = os.path.join(model_register_dir, key)
@@ -473,8 +503,16 @@ def _build_fleet_group(
                 results[machine.name] = cached
                 continue
         pending.append(machine)
+        pending_kwargs[machine.name] = kw
     if not pending:
         return
+
+    # per-member vector knobs (LR/ES patience) for FleetTrainer.fit —
+    # PENDING machines only: cache-hit members never reach the trainer,
+    # and fit() rejects hparams for members it wasn't given
+    member_hparams = {
+        m.name: _member_hparams_of(pending_kwargs[m.name]) for m in pending
+    }
 
     # host-side data loading (the IO hot loop, SURVEY.md §3.1). One process
     # feeds the whole gang here (SURVEY.md §7 hard part 2); stage_members
@@ -507,6 +545,7 @@ def _build_fleet_group(
         pending = [m for m in pending if m.name != machine.name]
         member_data.pop(machine.name, None)
         datasets_meta.pop(machine.name, None)
+        member_hparams.pop(machine.name, None)
         results[machine.name] = provide_saved_model(
             machine.name,
             machine.model,
@@ -541,8 +580,15 @@ def _build_fleet_group(
     t1 = time.time()
     from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
 
+    # CV fold members train with their machine's own hyperparameters
+    for name, (splits, _Xv) in cv_plan.items():
+        for fold in range(len(splits)):
+            member_hparams[_cv_key(name, fold)] = member_hparams[name]
+
     with maybe_profile(f"fleet-gang-{len(pending)}m"):
-        fleet_models = trainer.fit({**member_data, **fold_data})
+        fleet_models = trainer.fit(
+            {**member_data, **fold_data}, member_hparams=member_hparams
+        )
     train_elapsed = time.time() - t1
     trainer.last_stats["device_memory"] = device_memory_stats()
     if fold_data:

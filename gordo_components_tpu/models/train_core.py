@@ -30,9 +30,21 @@ class TrainState(NamedTuple):
     rng: jax.Array
 
 
-def make_optimizer(name: str = "adam", learning_rate: float = 1e-3, **kwargs) -> optax.GradientTransformation:
+def make_optimizer(
+    name: str = "adam",
+    learning_rate: float = 1e-3,
+    inject: bool = False,
+    **kwargs,
+) -> optax.GradientTransformation:
     """Resolve an optax optimizer by name (reference models compile with
-    Keras optimizer names; same strings work here)."""
+    Keras optimizer names; same strings work here).
+
+    ``inject=True`` wraps the optimizer in ``optax.inject_hyperparams`` so
+    ``learning_rate`` lives in the opt STATE instead of being baked into
+    the transform — under ``vmap`` that state leaf is a stacked (M,)
+    vector, which is how the fleet engine trains members with per-member
+    learning rates in ONE program (numerics identical when every member
+    shares the base value)."""
     name = name.lower()
     table = {
         "adam": optax.adam,
@@ -42,9 +54,14 @@ def make_optimizer(name: str = "adam", learning_rate: float = 1e-3, **kwargs) ->
         "adagrad": optax.adagrad,
     }
     try:
-        return table[name](learning_rate, **kwargs)
+        factory = table[name]
     except KeyError:
         raise ValueError(f"Unknown optimizer {name!r}; known: {sorted(table)}")
+    if inject:
+        return optax.inject_hyperparams(factory)(
+            learning_rate=learning_rate, **kwargs
+        )
+    return factory(learning_rate, **kwargs)
 
 
 def pad_to_batches(

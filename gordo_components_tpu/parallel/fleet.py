@@ -72,6 +72,18 @@ def _transform_all(scalers, X):
     return jax.vmap(scaler_transform)(scalers, X)
 
 
+def _set_stacked_lr(states, lr_vec):
+    """Overwrite the injected opt state's stacked learning-rate leaf with
+    a per-member (M,) vector. TrainState and InjectHyperparamsState are
+    both NamedTuples, so this is pure ``_replace`` surgery — no retrace,
+    no program split."""
+    os_ = states.opt_state
+    current = os_.hyperparams["learning_rate"]
+    hp = dict(os_.hyperparams)
+    hp["learning_rate"] = jnp.asarray(lr_vec, current.dtype)
+    return states._replace(opt_state=os_._replace(hyperparams=hp))
+
+
 def _select_improved(improved, best_tree, new_tree):
     """Per-model select: where ``improved`` (M,) is set, take the new
     leaves; else keep the best-so-far. Shared by the per-epoch host loop
@@ -139,7 +151,10 @@ class _BucketPrograms:
     ):
         self.module = module
         self.seq = seq
-        optimizer = train_core.make_optimizer(opt_name, lr)
+        # inject=True: the learning rate lives in the (vmapped, stacked)
+        # opt state, so _fit_bucket can overwrite it with a per-member
+        # (M,) vector — members differing only in LR share this program
+        optimizer = train_core.make_optimizer(opt_name, lr, inject=True)
         if seq is None:
             init_fn, epoch_fn = train_core.make_train_fns(
                 module, optimizer, batch_size, loss=loss, kl_weight=kl_weight
@@ -368,24 +383,27 @@ class _BucketPrograms:
 
         return fit_error_scalers
 
-    def chunk_fn(self, K: int, es_enabled: bool, es_p0, delta, use_val: bool = False):
+    def chunk_fn(self, K: int, es_enabled: bool, delta, use_val: bool = False):
         """K-epoch device chunk with (optional) on-device early stopping,
         monitoring validation loss when ``use_val`` (members without val
         rows fall back to train loss, as BaseEstimator.fit effectively
-        does)."""
-        # ES-off programs ignore p0/delta: normalize them out of the key
-        # so trainers differing only in unused ES knobs share the compile
+        does). The patience RESET value arrives as a traced (M,) vector
+        argument (``p0v``), not a static constant — members with
+        different patience share one compile, and per-member ES patience
+        costs nothing."""
+        # ES-off programs ignore delta: normalize it out of the key so
+        # trainers differing only in unused ES knobs share the compile
         key = (
-            (K, True, int(es_p0), float(delta), bool(use_val))
+            (K, True, float(delta), bool(use_val))
             if es_enabled
-            else (K, False, 0, 0.0, bool(use_val))
+            else (K, False, 0.0, bool(use_val))
         )
         if key not in self._chunks:
             vm_epoch = self._vm_epoch
             vm_eval = self._vm_eval
 
             @functools.partial(jax.jit, donate_argnums=(0,))
-            def run_chunk(carry, X, mask, val_mask):
+            def run_chunk(carry, X, mask, val_mask, p0v):
                 # body closes over run_chunk's traced X/mask args — NOT
                 # outer device arrays, which jit would bake in as constants.
                 # Each epoch emits (loss, val_loss, pre-epoch active) so the
@@ -419,7 +437,7 @@ class _BucketPrograms:
                         bp = _select_improved(select, bp, st2.params)
                         pat = jnp.where(
                             improved,
-                            jnp.int32(es_p0),
+                            p0v.astype(jnp.int32),
                             pat - (act > 0).astype(jnp.int32),
                         )
                         act = jnp.where(
@@ -645,6 +663,12 @@ class FleetMemberModel:
         return det
 
 
+# the engine's base learning rate (BaseEstimator's default too) — exported
+# so fleet_build can normalize "machine omitted learning_rate" to the same
+# value the trainer would use, instead of inheriting another machine's
+DEFAULT_LEARNING_RATE = 1e-3
+
+
 class FleetTrainer:
     """Train one homogeneous architecture across many machines' datasets.
 
@@ -658,7 +682,7 @@ class FleetTrainer:
         kind: Optional[str] = None,  # default resolves per model family
         epochs: int = 10,
         batch_size: int = 100,  # matches BaseEstimator's default
-        learning_rate: float = 1e-3,
+        learning_rate: float = DEFAULT_LEARNING_RATE,
         optimizer: str = "adam",
         early_stopping_patience: Optional[int] = None,
         early_stopping_min_delta: float = 0.0,
@@ -761,12 +785,44 @@ class FleetTrainer:
 
     # ------------------------------------------------------------------ #
 
-    def fit(self, members: Dict[str, np.ndarray]) -> Dict[str, FleetMemberModel]:
+    def fit(
+        self,
+        members: Dict[str, np.ndarray],
+        member_hparams: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> Dict[str, FleetMemberModel]:
         """``members``: name -> (n_rows_i, n_features_i) float array.
         Returns name -> FleetMemberModel. One compiled program per
         (n_features, padded_items) bucket, where items are the training
-        units (rows for the dense family, window starts for sequences)."""
+        units (rows for the dense family, window starts for sequences).
+
+        ``member_hparams``: optional name -> {"learning_rate": float,
+        "early_stopping_patience": int} overrides. These are STACKED
+        (M,) vectors inside the bucket programs (LR rides the injected
+        opt state, patience the ES carry), so members differing only in
+        these knobs train in ONE program instead of separate gangs
+        (SURVEY.md §7 hard part 4: per-model LR). A patience override
+        requires ES to be enabled on the trainer — silently enabling it
+        for one member would change the gang's program shape.
+        """
         t0 = time.time()
+        self._member_hparams = {}
+        for name, hp in (member_hparams or {}).items():
+            if name not in members:
+                raise ValueError(f"member_hparams for unknown member {name!r}")
+            unknown = set(hp) - {"learning_rate", "early_stopping_patience"}
+            if unknown:
+                raise ValueError(
+                    f"member_hparams[{name!r}]: unsupported keys {sorted(unknown)}"
+                )
+            if (
+                hp.get("early_stopping_patience") is not None
+                and self.early_stopping_patience is None
+            ):
+                raise ValueError(
+                    f"member_hparams[{name!r}] sets early_stopping_patience "
+                    "but the trainer has ES disabled"
+                )
+            self._member_hparams[name] = dict(hp)
         buckets: Dict[Tuple[int, int], List[str]] = {}
         # accept DataFrames: keep tag names for the anomaly contract
         self._tags_map = {
@@ -941,6 +997,27 @@ class FleetTrainer:
         # shape-inference sample: one row (dense) or one window (sequence)
         sample = Xd[:, 0, :] if seq is None else Xd[:, : self.lookback_window, :]
         states = init_stacked(rngs, sample)
+
+        # ---- per-member hyperparameter vectors (mesh-padding dummies
+        # replicate their source member's values, like the data) ----
+        hparams = getattr(self, "_member_hparams", {})
+
+        def _mvec(key, base, dtype):
+            return np.array(
+                [
+                    hparams.get(names[i % M_real], {}).get(key, base)
+                    for i in range(M)
+                ],
+                dtype=dtype,
+            )
+
+        lr_vec = _mvec("learning_rate", self.learning_rate, np.float32)
+        if hparams:
+            # the injected opt state carries learning_rate as a stacked
+            # (M,) leaf (vmapped init broadcasts the base scalar):
+            # overwrite it with the per-member vector — the ONLY surgery
+            # per-member LR needs, no extra program or gang split
+            states = _set_stacked_lr(states, lr_vec)
         state_treedef = jax.tree.structure(states)
 
         # ---- epoch loop: device does the work; host only sees (M,) losses
@@ -948,11 +1025,15 @@ class FleetTrainer:
         active = np.ones((M,), dtype=np.float32)
         best = np.full((M,), np.inf)
         es_enabled = self.early_stopping_patience is not None
-        patience = np.full(
-            (M,),
-            self.early_stopping_patience if es_enabled else -1,
-            dtype=np.int64,
+        # patience RESET values, per member (scalar broadcast when no
+        # overrides): both the host ES loop and the chunked device ES use
+        # this vector, so per-member patience is free in either path
+        p0_vec = (
+            _mvec("early_stopping_patience", self.early_stopping_patience, np.int64)
+            if es_enabled
+            else np.full((M,), -1, dtype=np.int64)
         )
+        patience = p0_vec.copy()
         histories: List[List[float]] = [[] for _ in range(M)]
         histories_val: List[List[float]] = [[] for _ in range(M)]
 
@@ -988,6 +1069,13 @@ class FleetTrainer:
                     self.epochs,
                     self.batch_size,
                     self.learning_rate,
+                    # per-member overrides change training: key them so a
+                    # resume can't mix runs with different LR/patience
+                    sorted(
+                        (n, sorted(hp.items()))
+                        for n, hp in hparams.items()
+                        if n in names
+                    ),
                     self.optimizer,
                     self.early_stopping_patience,
                     self.early_stopping_min_delta,
@@ -1049,11 +1137,7 @@ class FleetTrainer:
                     best_params = None
                     active = np.ones((M,), dtype=np.float32)
                     best = np.full((M,), np.inf)
-                    patience = np.full(
-                        (M,),
-                        self.early_stopping_patience if es_enabled else -1,
-                        dtype=np.int64,
-                    )
+                    patience = p0_vec.copy()
                     histories = [[] for _ in range(M)]
                     histories_val = [[] for _ in range(M)]
                     start_epoch = 0
@@ -1145,7 +1229,7 @@ class FleetTrainer:
                             jnp.asarray(improved, jnp.float32),
                         )
                     patience = np.where(
-                        improved, self.early_stopping_patience, patience - (active > 0)
+                        improved, p0_vec, patience - (active > 0)
                     )
                     # patience=0 parity with BaseEstimator.fit: a model stops
                     # only after a NON-improving epoch exhausts patience — an
@@ -1164,13 +1248,13 @@ class FleetTrainer:
             # ---- bounded-epoch chunks (SURVEY.md §7 hard part 4): K epochs
             # per dispatch with early stopping evaluated ON DEVICE, so the
             # host syncs once per chunk instead of once per epoch ----
-            es_p0 = int(self.early_stopping_patience if es_enabled else -1)
             delta = float(self.early_stopping_min_delta)
+            p0_dev = jnp.asarray(p0_vec, jnp.int32)
 
             def get_chunk_fn(K: int):
                 # carry WITHOUT best-params when ES is off: carrying an
                 # alias of st.params alongside st would break donation
-                return progs.chunk_fn(K, es_enabled, es_p0, delta, use_val=use_val)
+                return progs.chunk_fn(K, es_enabled, delta, use_val=use_val)
 
             seeded = jnp.float32(0.0 if best_params is None else 1.0)
             if es_enabled and best_params is None:
@@ -1188,7 +1272,7 @@ class FleetTrainer:
                 K = min(sync, self.epochs - epoch)
                 te = time.time()
                 carry, (losses_k, vals_k, act_k) = get_chunk_fn(K)(
-                    carry, Xd, train_maskd, val_maskd
+                    carry, Xd, train_maskd, val_maskd, p0_dev
                 )
                 losses_k = np.asarray(losses_k)  # (K, M)
                 vals_k = np.asarray(vals_k)  # (K, M) val losses (NaN when off)

@@ -178,12 +178,15 @@ async def metadata_all(request: web.Request) -> web.Response:
     if bank is not None:
         body["bank"] = bank
     resp = web.json_response(body)
-    # metadata-all bodies are highly repetitive JSON (same keys per
-    # target); gzip takes a 10k-fleet digest snapshot from a few MB to a
-    # few hundred KB — and the FULL body from tens of MB — on the wire
-    # for clients that accept it (aiohttp only compresses when the client
-    # sent Accept-Encoding)
-    resp.enable_compression()
+    if want_digest:
+        # digest bodies are highly repetitive JSON (same keys per target);
+        # gzip takes a 10k-fleet snapshot from a few MB to a few hundred
+        # KB on the wire. DELIBERATELY digest-only: aiohttp compresses
+        # synchronously on the event loop, and gzipping a tens-of-MB full
+        # body would stall every concurrent scoring request — full-body
+        # consumers (the bulk client) are rare and throughput-bound, not
+        # wire-bound
+        resp.enable_compression()
     return resp
 
 
