@@ -206,7 +206,8 @@ def _group_key(ae_kwargs: Dict[str, Any]) -> Tuple:
         if k == "learning_rate":
             continue
         if k == "early_stopping_patience":
-            items.append((k, v is not None))
+            if v is not None:  # explicit None == omitted == ES off
+                items.append((k, True))
             continue
         items.append((k, repr(v)))
     return tuple(items)

@@ -1134,6 +1134,10 @@ class FleetTrainer:
                         exc_info=True,
                     )
                     states = init_stacked(rngs, sample)
+                    if hparams:
+                        # from-scratch restart must re-apply the same
+                        # per-member LR surgery the initial path did
+                        states = _set_stacked_lr(states, lr_vec)
                     best_params = None
                     active = np.ones((M,), dtype=np.float32)
                     best = np.full((M,), np.inf)

@@ -136,6 +136,10 @@ class TestGangGrouping:
         assert _group_key(dict(base, early_stopping_patience=2)) != _group_key(
             base
         )
+        # explicit None == omitted == ES off: same gang
+        assert _group_key(
+            dict(base, early_stopping_patience=None)
+        ) == _group_key(base)
         # anything else still splits
         assert _group_key(dict(base, epochs=4)) != _group_key(base)
 
