@@ -705,6 +705,38 @@ def bench_host_pipeline(n_members=1000, n_tags=10, days=30):
     return out
 
 
+def bench_north_star_serving(n_members=10000, epochs=2, concurrency=64):
+    """Config 5 at the north star (VERDICT r3 next #3): train 10k ragged
+    members in one gang, stack them into ONE HBM ModelBank, and serve
+    concurrent load through the continuous-batching engine — bank build
+    time, request latency percentiles, throughput, and host RSS from one
+    process (tools/north_star_check.py, whose full document BASELINE.md
+    cites)."""
+    import os
+    import sys
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from north_star_check import run_check
+
+    res = run_check(members=n_members, epochs=epochs, concurrency=concurrency)
+    return {
+        "north_star_members": n_members,
+        "north_star_train_seconds": res["phases"]["train"]["seconds"],
+        "north_star_xla_programs": res["phases"]["train"]["xla_programs"],
+        "north_star_bank_build_seconds": res["phases"]["bank"]["seconds"],
+        "north_star_bank_buckets": res["phases"]["bank"]["n_buckets"],
+        "north_star_serving_p50_ms": res["serving"]["p50_ms"],
+        "north_star_serving_p99_ms": res["serving"]["p99_ms"],
+        "north_star_serving_samples_per_sec": res["serving"]["samples_per_sec"],
+        "north_star_serving_avg_batch": res["serving"]["avg_batch"],
+        "north_star_peak_rss_mb": res["peak_rss_mb"],
+        "north_star_digest_gzip_mb": res["control_plane"]["digest_gzip_mb"],
+        "north_star_device_memory": res.get("device_memory") or None,
+    }
+
+
 def bench_client_bulk(n_models=16, rows=3000, batch_size=500):
     """Bulk-client throughput through the real HTTP path (VERDICT r2 weak
     #7): rows/sec scoring a collection with JSON bodies vs parquet
@@ -896,6 +928,7 @@ METRICS = (
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
     ("client_bulk", bench_client_bulk),
+    ("north_star", bench_north_star_serving),
 )
 
 # The CPU fallback exists to keep the JSON line complete when the TPU is
@@ -916,6 +949,9 @@ CPU_KWARGS = {
     "bank_sequence": dict(n_models=8, iters=5),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
+    # the full 10k leg takes ~2.5 min on one core (measured; most of it
+    # the train phase) — shrink members, keep the serve/bank phases real
+    "north_star": dict(n_members=1024, epochs=1, concurrency=32),
 }
 
 # --quick mode (VERDICT r3 next #1b): a narrow tunnel window must still

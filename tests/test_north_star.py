@@ -1,0 +1,39 @@
+"""North-star serving check harness (tools/north_star_check.py): the
+10k-scale train->bank->serve pipeline at CI size, so the committed
+NORTH_STAR artifact's generator can't bit-rot."""
+
+import os
+import sys
+
+import numpy as np
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from north_star_check import run_check  # noqa: E402
+
+
+def test_run_check_end_to_end():
+    res = run_check(
+        members=48, min_rows=140, max_rows=200, epochs=1,
+        concurrency=8, requests_per_client=2, request_rows=32,
+    )
+    assert res["phases"]["bank"]["banked"] == 48
+    assert res["phases"]["bank"]["n_buckets"] == 1  # shared arch: ONE stack
+    assert res["phases"]["train"]["xla_programs"] <= 4  # quantized ladder
+    s = res["serving"]
+    assert s["requests"] == 16
+    assert 0 < s["p50_ms"] <= s["p99_ms"]
+    assert s["samples_per_sec"] > 0
+    assert s["avg_batch"] >= 1
+    assert s["queue_wait"]["count"] == 16
+    cp = res["control_plane"]
+    assert cp["digest_mb"] < cp["full_metadata_mb"]
+    assert cp["digest_gzip_mb"] < cp["digest_mb"]
+    assert res["peak_rss_mb"] > 0
+    assert np.isfinite(
+        [s["p50_ms"], s["p99_ms"], s["samples_per_sec"]]
+    ).all()

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""North-star serving check (VERDICT r3 next #3; BASELINE.json config 5).
+
+The round-3 engine check proved the BUILD leg at 10k models (staging +
+one FleetTrainer process); this script proves the SERVE leg: the same
+scale of members stacked into one HBM ModelBank behind one serving
+process, with measured construction cost and request latency under
+concurrent continuously-batched load.
+
+Phases (each timed, with host RSS after):
+  1. synth    — ragged member data (600-1440 rows x tags, sine+noise)
+  2. train    — one FleetTrainer gang, 2 epochs (the build leg, for scale
+                context; BASELINE.md carries the full staged version)
+  3. estimators — FleetMemberModel -> DiffBasedAnomalyDetector per member
+                (the artifact-object shape the server collection holds)
+  4. bank     — ModelBank.from_models over all members (the per-model
+                Python extraction loop this check exists to measure)
+  5. warmup   — per-bucket XLA pre-compile
+  6. serve    — BatchingEngine under concurrent clients: client-side
+                p50/p99, throughput, coalescing stats, queue-wait split
+
+Prints one JSON document; run with --members 10000 for the north star
+(defaults are CI-sized). CPU-safe: pass --platform cpu (in-process pin —
+the env var hangs under the axon site hook on this box).
+"""
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_mb() -> float:
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
+
+
+def run_check(
+    members: int = 512,
+    tags: int = 10,
+    min_rows: int = 600,
+    max_rows: int = 1440,
+    epochs: int = 2,
+    platform: str | None = None,
+    concurrency: int = 64,
+    requests_per_client: int = 4,
+    request_rows: int = 64,
+) -> dict:
+    """The full check as a callable (bench.py runs it as a metric; the
+    CLI below wraps it). Returns the result document."""
+
+    from types import SimpleNamespace
+
+    args = SimpleNamespace(
+        members=members, tags=tags, min_rows=min_rows, max_rows=max_rows,
+        epochs=epochs, platform=platform, concurrency=concurrency,
+        requests_per_client=requests_per_client, request_rows=request_rows,
+    )
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from gordo_components_tpu.parallel.fleet import FleetTrainer
+    from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
+    from gordo_components_tpu.utils.profiling import device_memory_stats
+
+    out = {"config": dict(vars(args)), "phases": {}}
+
+    def phase(name, t0):
+        out["phases"][name] = {
+            "seconds": round(time.time() - t0, 1),
+            "peak_rss_mb": rss_mb(),
+        }
+
+    # ---- 1. synth ragged members ----
+    t0 = time.time()
+    rng = np.random.RandomState(0)
+    t = np.arange(args.max_rows)
+    members = {}
+    for i in range(args.members):
+        rows = int(rng.randint(args.min_rows, args.max_rows + 1))
+        freqs = 0.01 + 0.002 * rng.rand(args.tags)
+        phases_ = 2 * np.pi * rng.rand(args.tags)
+        X = np.sin(np.outer(t[:rows], freqs) + phases_) + rng.normal(
+            scale=0.05, size=(rows, args.tags)
+        )
+        members[f"machine-{i}"] = X.astype("float32")
+    phase("synth", t0)
+
+    # ---- 2. train the gang ----
+    t0 = time.time()
+    trainer = FleetTrainer(
+        kind="feedforward_hourglass", epochs=args.epochs, batch_size=128,
+        host_sync_every=args.epochs,
+    )
+    fleet = trainer.fit(members)
+    phase("train", t0)
+    out["phases"]["train"]["n_members"] = len(fleet)
+    out["phases"]["train"]["xla_programs"] = len(trainer.last_stats["buckets"])
+
+    # ---- 3. estimator objects (what a server collection holds) ----
+    t0 = time.time()
+    models = {name: fm.to_estimator() for name, fm in fleet.items()}
+    phase("estimators", t0)
+
+    # ---- 4. bank construction (the startup Python loop) ----
+    t0 = time.time()
+    bank = ModelBank.from_models(models)
+    bank_elapsed = time.time() - t0  # unrounded: CI-sized builds are ~ms
+    phase("bank", t0)
+    cov = bank.coverage()
+    out["phases"]["bank"].update(
+        banked=cov["banked"], n_buckets=cov["n_buckets"],
+        fallback=len(cov["fallback"]),
+        models_per_sec=round(len(models) / max(1e-9, bank_elapsed), 1),
+    )
+    assert cov["banked"] == args.members, cov
+
+    # ---- 5. warmup (per-bucket XLA compile, off the request path) ----
+    t0 = time.time()
+    warmed = bank.warmup(rows=args.request_rows)
+    phase("warmup", t0)
+    out["phases"]["warmup"]["buckets"] = warmed
+    out["device_memory"] = device_memory_stats()
+
+    # ---- 6. concurrent serving latency through the real engine ----
+    import asyncio
+
+    reqs = {
+        name: rng.rand(args.request_rows, args.tags).astype("float32")
+        for name in list(models)[: max(args.concurrency * 4, 256)]
+    }
+    req_names = list(reqs)
+
+    async def drive():
+        engine = BatchingEngine(bank, max_batch=args.concurrency, flush_ms=2.0)
+        engine.start()
+        lat: list = []
+
+        async def client(ci):
+            for k in range(args.requests_per_client):
+                name = req_names[(ci * args.requests_per_client + k) % len(req_names)]
+                t0 = time.monotonic()
+                r = await engine.score(name, reqs[name])
+                lat.append(time.monotonic() - t0)
+                assert np.isfinite(r.total_scaled).all()
+
+        await asyncio.gather(*(client(i) for i in range(args.concurrency)))
+        await engine.stop()
+        return lat, engine
+
+    asyncio.run(drive())  # warm round: compiles the coalesced batch shapes
+    t0 = time.time()
+    lat, engine = asyncio.run(drive())
+    wall = time.time() - t0
+    lat.sort()
+    pct = lambda q: round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 2)
+    out["serving"] = {
+        "requests": len(lat),
+        "concurrency": args.concurrency,
+        "rows_per_request": args.request_rows,
+        "p50_ms": pct(0.50),
+        "p95_ms": pct(0.95),
+        "p99_ms": pct(0.99),
+        "requests_per_sec": round(len(lat) / wall, 1),
+        "samples_per_sec": round(len(lat) * args.request_rows / wall, 1),
+        "avg_batch": round(
+            engine.stats["requests"] / max(1, engine.stats["batches"]), 2
+        ),
+        "queue_wait": engine.queue_wait.snapshot(),
+    }
+    # ---- 7. control-plane snapshot size at this scale (VERDICT r3 #5:
+    # the digest exists so watchman's periodic poll of an N-model fleet
+    # is O(small) bytes; measure both bodies as metadata-all would build
+    # them, with representative per-member metadata) ----
+    import gzip
+
+    from gordo_components_tpu.utils.digest import metadata_digest
+
+    def fat_meta(name):
+        return {
+            "name": name,
+            "checked_at": "2026-07-31T00:00:00+00:00",
+            "dataset": {"tag_list": [{"name": f"t-{j}"} for j in range(args.tags)]},
+            "model": {
+                "model_config": {
+                    "gordo_components_tpu.models.DiffBasedAnomalyDetector": {}
+                },
+                "model_builder_cache_key": f"{hash(name) & 0xFFFFFFFF:064x}",
+                "trained": True,
+                "fleet_trained": True,
+                "history": {"loss": [0.1] * 50},
+            },
+        }
+
+    full_body = {n: {"healthy": True, "endpoint-metadata": fat_meta(n)} for n in models}
+    digest_body = {
+        n: {"healthy": True, "digest": metadata_digest(fat_meta(n))} for n in models
+    }
+    full_json = json.dumps(full_body).encode()
+    digest_json = json.dumps(digest_body).encode()
+    out["control_plane"] = {
+        "targets": len(models),
+        "full_metadata_mb": round(len(full_json) / 1e6, 2),
+        "digest_mb": round(len(digest_json) / 1e6, 2),
+        "digest_gzip_mb": round(len(gzip.compress(digest_json, 6)) / 1e6, 3),
+    }
+
+    out["peak_rss_mb"] = rss_mb()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--members", type=int, default=512)
+    ap.add_argument("--tags", type=int, default=10)
+    ap.add_argument("--min-rows", type=int, default=600)
+    ap.add_argument("--max-rows", type=int, default=1440)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--platform", default=None,
+                    help="in-process jax platform pin (e.g. cpu)")
+    ap.add_argument("--concurrency", type=int, default=64)
+    ap.add_argument("--requests-per-client", type=int, default=4)
+    ap.add_argument("--request-rows", type=int, default=64)
+    a = ap.parse_args()
+    print(json.dumps(run_check(**vars(a)), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
