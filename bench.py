@@ -899,7 +899,7 @@ def _bench_family_fleet(
         FleetTrainer(host_sync_every=1, **single_cfg).fit({name: members[name]})
     single_rate = n_probe / (time.time() - t0) * 3600 / n_chips
 
-    return {
+    out = {
         f"{fam}_fleet_models_per_hour_per_chip": round(fleet_rate, 1),
         f"{fam}_fleet_wall_seconds": round(elapsed, 2),
         f"{fam}_fleet_vs_single_same_arch": round(fleet_rate / single_rate, 1),
@@ -909,6 +909,20 @@ def _bench_family_fleet(
             + f"{epochs} epochs, bf16"
         ),
     }
+    if fam == "conv":
+        # conv-impl A/B on THIS backend: the slice+matmul formulation has
+        # exact numeric parity with the stock conv ops; the winner is
+        # config- and backend-dependent (CPU: matmul 1.24x faster at THIS
+        # bench config, slower at larger f32 shapes), so the ratio is
+        # recorded wherever the bench runs (models/factories/conv.py)
+        mm_cfg = dict(config, conv_impl="matmul")
+        FleetTrainer(**mm_cfg).fit(members)  # warm
+        t0 = time.time()
+        FleetTrainer(**mm_cfg).fit(members)
+        mm_elapsed = time.time() - t0
+        out["conv_matmul_impl_vs_lax"] = round(elapsed / mm_elapsed, 2)
+        out["conv_matmul_impl_wall_seconds"] = round(mm_elapsed, 2)
+    return out
 
 
 def _family_fleet_metric(fam):

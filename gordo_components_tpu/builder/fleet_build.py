@@ -97,7 +97,7 @@ _FACTORY_KEYS = frozenset(
     {
         "encoding_dim", "decoding_dim", "encoding_func", "decoding_func",
         "out_func", "dims", "funcs", "encoding_layers", "compression_factor",
-        "func", "channels", "kernel_size", "latent_dim",
+        "func", "channels", "kernel_size", "latent_dim", "conv_impl",
     }
 )
 
