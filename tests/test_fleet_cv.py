@@ -171,6 +171,45 @@ class TestGangCV:
         r3 = build_fleet(with_cv, **kwargs)
         assert os.path.getmtime(os.path.join(r3["m-0"], "model.pkl")) == mtime
 
+    def test_sequence_family_cv_in_gang(self, tmp_path):
+        """LSTM machines with feasible folds (lookback <= fold length)
+        gang-train their CV folds too — gather-windowed fold members ride
+        the same stacked axis."""
+        lstm = {
+            "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_components_tpu.models.LSTMAutoEncoder": {
+                                    # 72 rows -> 18-row folds; lookback 8
+                                    # fits every fold
+                                    "lookback_window": 8,
+                                    "epochs": 2,
+                                    "batch_size": 16,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        }
+        machines = [
+            Machine(
+                name="seq-0",
+                dataset=dict(DATASET),
+                model=lstm,
+                evaluation=dict(EVALUATION),
+            )
+        ]
+        results = build_fleet(machines, str(tmp_path / "out"))
+        md = serializer.load_metadata(results["seq-0"])["model"]
+        assert md["fleet_trained"]
+        ev = md["cross-validation"]["explained-variance"]
+        assert len(ev["per-fold"]) == 3
+        assert np.isfinite(ev["per-fold"]).all()
+
     def test_infeasible_folds_fall_back_to_single_path(self, tmp_path, monkeypatch):
         """Sequence machines whose fold slices are shorter than the warmup
         route to the single-build path instead of crashing the gang."""
