@@ -235,6 +235,26 @@ def _hbm_traffic_model(params, padded_rows, n_features, epochs, n_models,
     return float((data + state) * epochs * n_models)
 
 
+def _timed_fleet_fit(config, members, n_chips):
+    """Warm + timed FleetTrainer fit -> (models/hour/chip, seconds, trainer).
+
+    The warmup fit uses the SAME config and member shapes (XLA specializes
+    per shape); the process-wide program cache then makes the timed fit
+    measure steady-state training, not tracing/XLA compilation. Shared by
+    the fleet headline, the wide-width leg, and the width sweep so the
+    warmup convention and the per-chip divisor can't silently diverge.
+    """
+    from gordo_components_tpu.parallel import FleetTrainer
+
+    FleetTrainer(**config).fit(members)
+    trainer = FleetTrainer(**config)
+    t0 = time.time()
+    trainer.fit(members)
+    elapsed = time.time() - t0
+    rate = len(members) / elapsed * 3600 / n_chips
+    return rate, elapsed, trainer
+
+
 def bench_fleet(
     n_models=1024, rows=1440, n_features=10, epochs=5, batch_size=128,
     host_sync_every=5,
@@ -242,10 +262,13 @@ def bench_fleet(
     """Config 3 — many-model fleet training: models/hour/chip + FLOP/s +
     estimated HBM bytes/s (the honest roof for tiny models).
     ``host_sync_every`` is the on-device chunk size; with the defaults
-    (epochs=5, chunk=5) the whole epoch budget is one dispatch."""
-    import jax
+    (epochs=5, chunk=5) the whole epoch budget is one dispatch.
 
-    from gordo_components_tpu.parallel import FleetTrainer
+    The headline stays at width 1024: BASELINE.json config 3 is a
+    1k-machine fleet, and every prior round's number is comparable at that
+    width. The knee-width rate lives in its own ``fleet_wide`` metric so a
+    wedge there can't take the headline down with it."""
+    import jax
 
     members = _synth_fleet(n_models, rows, n_features)
     config = dict(
@@ -255,17 +278,10 @@ def bench_fleet(
         compute_dtype="bfloat16",
         host_sync_every=host_sync_every,
     )
-    # warmup with the SAME config and member shapes (XLA specializes per
-    # shape): the process-wide program cache means the timed run below
-    # measures steady-state training, not tracing/XLA compilation
-    FleetTrainer(**config).fit(members)
-
-    trainer = FleetTrainer(**config)
-    t0 = time.time()
-    trainer.fit(members)
-    elapsed = time.time() - t0
     n_chips = len(jax.devices())
-    models_per_hour_per_chip = n_models / elapsed * 3600 / n_chips
+    models_per_hour_per_chip, elapsed, trainer = _timed_fleet_fit(
+        config, members, n_chips
+    )
 
     # FLOPs: ES is off, so every model runs every epoch over its padded
     # rows. 6 * params per sample-step (fwd 2x + bwd 4x, dense convention).
@@ -282,7 +298,7 @@ def bench_fleet(
     hbm_bytes = _hbm_traffic_model(
         params, padded_rows, n_features, epochs, n_models, batch_size
     )
-    return {
+    out = {
         "fleet_models_per_hour_per_chip": round(models_per_hour_per_chip, 1),
         "fleet_wall_seconds": round(elapsed, 2),
         "model_params": params,
@@ -296,6 +312,64 @@ def bench_fleet(
             f"hourglass AE, {epochs} epochs, bf16, chunk={host_sync_every}"
         ),
     }
+    return out
+
+
+def bench_fleet_wide(
+    width="auto", rows=1440, n_features=10, epochs=5, batch_size=128,
+):
+    """Fleet training at the knee of the measured width->rate curve.
+
+    Times the FULL headline config (1440 rows, 5 epochs) at the widest
+    width the curve still rewards — the single-chip rate an operator
+    actually gets by raising the gang width. ``width="auto"`` uses the
+    knee ``bench_width_sweep`` measured earlier in this same child
+    process (METRICS order puts the sweep first), so the knee tracks the
+    hardware instead of being frozen from one past artifact. A resume
+    child that skipped the sweep receives the measured knee via
+    ``--knee``; only when no measurement exists at all does it fall back
+    to 4096 — the knee in BENCH_TPU_20260731_040835.json — and the
+    provenance is recorded either way. A separate metric (not a leg of ``fleet``)
+    so the supervisor's per-metric watchdog keeps a wedge here from
+    discarding the already-measured headline. ``width=None`` skips (one
+    CPU core gains nothing from vmap width)."""
+    import jax
+
+    if not width:
+        return {"fleet_wide_skipped": "width=None (CPU: vmap width gains nothing)"}
+    if width == "auto":
+        if _SWEEP_KNEE["width"]:
+            width, source = _SWEEP_KNEE["width"], "width_sweep knee (this run)"
+        else:
+            width, source = 4096, "default 4096 (sweep absent in this process)"
+    else:
+        source = "explicit"
+    if width == 1024:
+        # the headline fleet metric already times this exact config in
+        # this child — don't burn a narrow tunnel window on a duplicate
+        return {"fleet_wide_skipped": "knee equals the 1024 headline width"}
+    config = dict(
+        kind="feedforward_hourglass", epochs=epochs, batch_size=batch_size,
+        compute_dtype="bfloat16", host_sync_every=epochs,
+    )
+    rate, elapsed, _ = _timed_fleet_fit(
+        config, _synth_fleet(width, rows, n_features), len(jax.devices())
+    )
+    return {
+        "fleet_wide_models_per_hour_per_chip": round(rate, 1),
+        "fleet_wide_width": int(width),
+        "fleet_wide_width_source": source,
+        "fleet_wide_wall_seconds": round(elapsed, 2),
+        "fleet_wide_config": (
+            f"{width} models x {rows} rows x {n_features} tags, hourglass "
+            f"AE, {epochs} epochs, bf16"
+        ),
+    }
+
+
+# knee measured by bench_width_sweep in THIS process, consumed by
+# bench_fleet_wide (they run sequentially in the same metrics child)
+_SWEEP_KNEE = {"width": None}
 
 
 def bench_width_sweep(widths=(256, 1024, 2048, 4096), rows=720, n_features=10,
@@ -305,8 +379,6 @@ def bench_width_sweep(widths=(256, 1024, 2048, 4096), rows=720, n_features=10,
     each width plus where the curve knees (last width whose per-model rate
     still improved >10%)."""
     import jax
-
-    from gordo_components_tpu.parallel import FleetTrainer
 
     n_chips = len(jax.devices())
     config = dict(
@@ -318,14 +390,12 @@ def bench_width_sweep(widths=(256, 1024, 2048, 4096), rows=720, n_features=10,
     knee = widths[0]
     for width in widths:
         members = _synth_fleet(width, rows, n_features)
-        FleetTrainer(**config).fit(members)  # per-width compile warmup
-        t0 = time.time()
-        FleetTrainer(**config).fit(members)
-        rate = width / (time.time() - t0) * 3600 / n_chips
+        rate, _, _ = _timed_fleet_fit(config, members, n_chips)
         curve[str(width)] = round(rate, 1)
         if prev_rate is not None and rate > prev_rate * 1.1:
             knee = width
         prev_rate = rate
+    _SWEEP_KNEE["width"] = int(knee)
     return {
         "width_sweep_models_per_hour": curve,
         "width_sweep_knee": int(knee),
@@ -941,13 +1011,18 @@ bench_conv_fleet = _family_fleet_metric("conv")
 bench_vae_fleet = _family_fleet_metric("vae")
 
 
+# Order is narrow-window priority, not taxonomy: a tunnel that wedges
+# mid-run keeps every metric already finished, so the ratio-critical pair
+# (fleet + sequential -> vs_baseline) runs first — the 2026-07-31 window
+# died after two metrics and lost the same-platform ratio to ordering.
 METRICS = (
     ("fleet", bench_fleet),
+    ("sequential", bench_single_sequential),
     ("width_sweep", bench_width_sweep),
+    ("fleet_wide", bench_fleet_wide),
     ("lstm_fleet", bench_lstm_fleet),
     ("conv_fleet", bench_conv_fleet),
     ("vae_fleet", bench_vae_fleet),
-    ("sequential", bench_single_sequential),
     ("server_scoring", bench_server_scoring),
     ("bank_serving", bench_bank_serving),
     ("bank_sequence", bench_bank_sequence),
@@ -966,6 +1041,7 @@ METRICS = (
 CPU_KWARGS = {
     "fleet": dict(n_models=256, epochs=3),
     "width_sweep": dict(widths=(64, 256), rows=256, epochs=2),
+    "fleet_wide": dict(width=None),
     "lstm_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
     "conv_fleet": dict(n_models=32, rows=256, lookback=16, epochs=2),
     "vae_fleet": dict(n_models=32, rows=256, epochs=2),
@@ -1033,14 +1109,20 @@ def run_metrics_child(skip: set, platform: str | None) -> None:
             print(f"METRIC {name} " + json.dumps(out), flush=True)
 
 
-def run_metrics_supervised(env_platform, detail, errors, skip, child_cmd=None):
+def run_metrics_supervised(
+    env_platform, detail, errors, skip, child_cmd=None, stall_seconds=None,
+    knee=None,
+):
     """Run the metric suite in a supervised child.
 
     The parent enforces a stall watchdog: if the child produces no new
-    metric line for STALL_SECONDS it is killed (a blocked recv never
-    raises, so this is the only recovery). Returns the set of metric names
-    that completed. ``child_cmd`` substitutes the child argv (tests drive
-    scripted children through the real supervisor with it)."""
+    metric line for ``stall_seconds`` (default STALL_SECONDS) it is killed
+    (a blocked recv never raises, so this is the only recovery). Returns
+    the set of metric names that completed. ``child_cmd`` substitutes the
+    child argv (tests drive scripted children through the real supervisor
+    with it)."""
+    if stall_seconds is None:
+        stall_seconds = STALL_SECONDS
     if child_cmd is not None:
         args = child_cmd
     else:
@@ -1051,6 +1133,10 @@ def run_metrics_supervised(env_platform, detail, errors, skip, child_cmd=None):
             args += ["--platform", env_platform]
         if skip:
             args += ["--skip", ",".join(sorted(skip))]
+        if knee:
+            # hand a knee measured by an earlier pass's width_sweep to a
+            # fresh child (module state doesn't survive the respawn)
+            args += ["--knee", str(int(knee))]
     proc = subprocess.Popen(
         args,
         stdout=subprocess.PIPE,
@@ -1114,7 +1200,7 @@ def run_metrics_supervised(env_platform, detail, errors, skip, child_cmd=None):
                 proc.wait()
                 break
             # wait for the next line with the stall deadline
-            if not got_line.wait(timeout=STALL_SECONDS):
+            if not got_line.wait(timeout=stall_seconds):
                 stalled = True
                 running = [n for n, _ in METRICS if n not in done]
                 wedged = started if started not in done and started else (
@@ -1122,7 +1208,7 @@ def run_metrics_supervised(env_platform, detail, errors, skip, child_cmd=None):
                 )
                 if proc.poll() is None:
                     errors[f"stall:{wedged}"] = (
-                        f"no progress for {STALL_SECONDS:.0f}s on "
+                        f"no progress for {stall_seconds:.0f}s on "
                         f"platform={env_platform or 'default'}; child killed"
                     )
                     proc.kill()
@@ -1139,12 +1225,89 @@ def run_metrics_supervised(env_platform, detail, errors, skip, child_cmd=None):
     rc = proc.returncode
     if rc not in (0, None) and not stalled:
         # abnormal exit (segfault/OOM-kill) that the stall path didn't
-        # already attribute: record it instead of silently losing metrics
-        errors["child_exit"] = (
-            f"benchmark child exited rc={rc} on "
-            f"platform={env_platform or 'default'}"
-        )
+        # already attribute: record it instead of silently losing metrics.
+        # Keyed by platform so a crash in a later recovery pass doesn't
+        # overwrite the first record, and the in-flight metric gets a
+        # crashed:<name> key so finish_missing_metrics treats it as a
+        # suspect (re-running an OOM-killer full-size would crash the
+        # resume pass too)
+        key = f"child_exit:{env_platform or 'default'}"
+        while key in errors:  # two passes can share a platform label
+            key += "+"
+        errors[key] = f"benchmark child exited rc={rc}"
+        if started and started not in done:
+            errors[f"crashed:{started}"] = (
+                f"in flight when the child exited rc={rc} on "
+                f"platform={env_platform or 'default'}"
+            )
     return done
+
+
+def finish_missing_metrics(done, detail, errors, env_platform, budget):
+    """Recover metrics the first supervised pass didn't finish.
+
+    A metric stalling on the accelerator can mean a transient tunnel wedge
+    (recovers in minutes) or a dead tunnel (stays wedged for hours) — both
+    observed on this box. Re-probe cheaply before abandoning the chip: the
+    2026-07-31 run lost 12 TPU metrics to one mid-run wedge that an
+    immediate CPU fallback made final. Only if the re-probe fails (or the
+    resumed run stalls again) do the remaining metrics re-run on CPU,
+    honestly labelled. Returns (done, fell_back) where fell_back is the
+    set of metrics whose numbers came from the CPU fallback — ratio
+    bookkeeping (vs_baseline, MFU) must exclude those.
+    """
+    missing = {n for n, _ in METRICS} - done
+    fell_back: set = set()
+    if missing and env_platform != "cpu":
+        re_platform, _, _, re_attempts = probe_backend(
+            budget=min(120.0, budget), attempt_timeout=60.0
+        )
+        detail["reprobe_after_stall"] = re_attempts
+        if re_platform and re_platform != "cpu":
+            # metrics that stalled or crashed are the ones most likely to
+            # do it again — exclude them from the resume (they re-run on
+            # CPU below) so a metric-inherent wedge/OOM can't burn a
+            # second STALL_SECONDS and push the CPU pass past the
+            # driver's whole-run timeout; only a second independent
+            # tunnel wedge can still stall the resume
+            stalled = {
+                k.split(":", 1)[1]
+                for k in errors
+                if k.startswith(("stall:", "crashed:"))
+            }
+            # pin the flavor that actually answered: on this box the
+            # 'tpu' pin and default resolution fail independently, and
+            # resuming via the dead flavor would hang in backend init
+            pin = re_platform if (
+                re_attempts and re_attempts[-1].get("flavor") == "tpu-pin"
+            ) else None
+            before = set(done)
+            # capped watchdog: the first stall already burned a full
+            # STALL_SECONDS, and the watcher/driver run bench under hard
+            # whole-process timeouts — a second independent tunnel wedge
+            # during the resume must not push the final headline print
+            # (and the TPU artifact already earned) past that envelope
+            done = run_metrics_supervised(
+                pin, detail, errors, done | stalled,
+                stall_seconds=min(STALL_SECONDS, 300.0),
+                knee=detail.get("width_sweep_knee"),
+            ) - (stalled - before)
+            resumed = sorted(done - before - stalled)
+            if resumed:
+                errors["stall_resume"] = (
+                    f"metrics {resumed} resumed on {re_platform} after a "
+                    "stall + successful re-probe"
+                )
+            missing = {n for n, _ in METRICS} - done
+    if missing and env_platform != "cpu":
+        errors["fallback"] = (
+            f"metrics {sorted(missing)} re-run on CPU after accelerator stall"
+        )
+        detail["fallback_platform"] = "cpu"
+        detail["fallback_metrics"] = sorted(missing)
+        fell_back = set(missing)
+        done = run_metrics_supervised("cpu", detail, errors, done)
+    return done, fell_back
 
 
 def write_tpu_artifact(headline, detail, errors):
@@ -1203,6 +1366,8 @@ def main():
         platform = None
         if "--platform" in sys.argv:
             platform = sys.argv[sys.argv.index("--platform") + 1]
+        if "--knee" in sys.argv:
+            _SWEEP_KNEE["width"] = int(sys.argv[sys.argv.index("--knee") + 1])
         run_metrics_child(skip, platform)
         return 0
 
@@ -1242,19 +1407,9 @@ def main():
     detail["n_devices"] = n_devices
 
     done = run_metrics_supervised(env_platform, detail, errors, set(base_skip))
-    missing = {n for n, _ in METRICS} - done
-    fell_back: set = set()
-    if missing and env_platform != "cpu":
-        # the accelerator data plane wedged mid-run (probe passed, a metric
-        # stalled): finish the remaining metrics on CPU so the line still
-        # carries every number, honestly labelled
-        errors["fallback"] = (
-            f"metrics {sorted(missing)} re-run on CPU after accelerator stall"
-        )
-        detail["fallback_platform"] = "cpu"
-        detail["fallback_metrics"] = sorted(missing)
-        fell_back = set(missing)
-        done = run_metrics_supervised("cpu", detail, errors, done)
+    done, fell_back = finish_missing_metrics(
+        done, detail, errors, env_platform, budget
+    )
     final_missing = {n for n, _ in METRICS} - done
     if final_missing:
         errors["missing_metrics"] = ", ".join(sorted(final_missing))

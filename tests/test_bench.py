@@ -95,10 +95,191 @@ def test_crash_mid_write_keeps_partial_results(bench):
     assert "fleet" in done
     assert detail["fleet_models_per_hour_per_chip"] == 7.0
     assert "malformed_line" in errors
-    assert "rc=139" in errors["child_exit"]
+    assert "rc=139" in errors["child_exit:default"]
 
 
 def test_abnormal_exit_without_output_is_recorded(bench):
     done, detail, errors, _ = _run(bench, "import sys; sys.exit(3)")
     assert done == set()
-    assert "rc=3" in errors["child_exit"]
+    assert "rc=3" in errors["child_exit:default"]
+
+
+def test_crash_attributes_the_in_flight_metric(bench):
+    # an OOM-killed child (no METRIC_ERROR line) must blame the metric
+    # that was mid-flight, so the recovery pass knows not to re-run it
+    # full-size on the accelerator
+    script = (
+        "print('METRIC fleet {\"fleet_models_per_hour_per_chip\": 7.0}', flush=True);"
+        "print('METRIC_START fleet_wide', flush=True);"
+        "import os; os._exit(137)"
+    )
+    done, detail, errors, _ = _run(bench, script)
+    assert done == {"fleet"}
+    assert "in flight" in errors["crashed:fleet_wide"]
+    assert "rc=137" in errors["child_exit:default"]
+
+
+def _all_metrics(bench):
+    return {n for n, _ in bench.METRICS}
+
+
+def _patch_recovery(
+    bench, monkeypatch, probe_results, run_outcomes, probe_flavor="tpu-pin"
+):
+    """Drive finish_missing_metrics with scripted probe/run behavior.
+
+    ``probe_results`` is a list of platforms the fake probe yields in
+    order; ``run_outcomes`` maps env_platform -> set of metrics the fake
+    supervised run completes (in addition to the skip set it's given);
+    ``probe_flavor`` is the flavor recorded on the successful attempt.
+    """
+    calls = {"probes": 0, "runs": [], "skips": []}
+
+    def fake_probe(budget=0.0, attempt_timeout=0.0):
+        platform = probe_results[min(calls["probes"], len(probe_results) - 1)]
+        calls["probes"] += 1
+        return platform, "fake", 1, [
+            {"flavor": probe_flavor, "outcome": str(platform)}
+        ]
+
+    def fake_run(env_platform, detail, errors, skip, child_cmd=None,
+                 stall_seconds=None, knee=None):
+        calls["runs"].append(env_platform)
+        calls["skips"].append(set(skip))
+        calls.setdefault("stalls", []).append(stall_seconds)
+        calls.setdefault("knees", []).append(knee)
+        return set(skip) | run_outcomes.get(env_platform, set())
+
+    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+    monkeypatch.setattr(bench, "run_metrics_supervised", fake_run)
+    return calls
+
+
+def test_stall_resume_keeps_remaining_metrics_on_accelerator(
+    bench, monkeypatch
+):
+    # first pass finished fleet+width_sweep then lstm_fleet stalled;
+    # re-probe answers via the tpu pin; the resumed accelerator run (with
+    # the stalled metric excluded and the pin flavor passed down) finishes
+    # the rest, and ONLY the stalled metric re-runs on CPU
+    calls = _patch_recovery(
+        bench, monkeypatch,
+        probe_results=["tpu"],
+        run_outcomes={
+            "tpu": _all_metrics(bench) - {"lstm_fleet", "fleet_wide"},
+            "cpu": _all_metrics(bench),
+        },
+    )
+    detail = {"width_sweep_knee": 2048}
+    errors = {
+        "stall:lstm_fleet": "no progress for 600s",
+        "crashed:fleet_wide": "in flight when the child exited rc=137",
+    }
+    done, fell_back = bench.finish_missing_metrics(
+        {"fleet", "width_sweep"}, detail, errors, None, 600.0
+    )
+    assert done == _all_metrics(bench)
+    # stall AND crash suspects go to CPU; everything else stays accelerator
+    assert fell_back == {"lstm_fleet", "fleet_wide"}
+    assert "fleet_wide" in calls["skips"][0]
+    assert calls["runs"] == ["tpu", "cpu"]
+    # the resume pass must skip the suspect metric (can't double-stall)
+    # and run under a capped watchdog so a second independent wedge can't
+    # push the run past the watcher/driver whole-process timeout
+    assert "lstm_fleet" in calls["skips"][0]
+    assert calls["stalls"][0] == 300.0
+    assert calls["stalls"][1] is None  # CPU pass keeps the full deadline
+    # the resume child inherits this run's measured knee, not the default
+    assert calls["knees"][0] == 2048
+    assert "stall_resume" in errors
+    assert "lstm_fleet" not in errors["stall_resume"]
+    assert "conv_fleet" in errors["stall_resume"]
+    assert detail["reprobe_after_stall"][0]["outcome"] == "tpu"
+
+
+def test_resume_uses_default_resolution_when_pin_flavor_failed(
+    bench, monkeypatch
+):
+    # the 2026-07-31 window answered via DEFAULT resolution while the
+    # 'tpu' pin errored; the resume must not pin the dead flavor
+    calls = _patch_recovery(
+        bench, monkeypatch,
+        probe_results=["tpu"],
+        run_outcomes={None: _all_metrics(bench)},
+        probe_flavor="default",
+    )
+    detail = {}
+    errors = {"stall:lstm_fleet": "no progress"}
+    done, fell_back = bench.finish_missing_metrics(
+        {"fleet"}, detail, errors, None, 600.0
+    )
+    assert calls["runs"][0] is None  # default resolution, not a pin
+    assert "lstm_fleet" in fell_back and "conv_fleet" not in fell_back
+
+
+def test_stall_with_dead_tunnel_falls_back_to_cpu(bench, monkeypatch):
+    calls = _patch_recovery(
+        bench, monkeypatch,
+        probe_results=[None],
+        run_outcomes={"cpu": _all_metrics(bench)},
+    )
+    detail = {}
+    errors = {"stall:width_sweep": "no progress"}
+    done, fell_back = bench.finish_missing_metrics(
+        {"fleet"}, detail, errors, None, 600.0
+    )
+    assert done == _all_metrics(bench)
+    assert fell_back == _all_metrics(bench) - {"fleet"}
+    assert detail["fallback_platform"] == "cpu"
+    assert "sequential" in detail["fallback_metrics"]
+    assert calls["runs"] == ["cpu"]
+    assert "stall_resume" not in errors
+
+
+def test_resume_that_stalls_again_still_reaches_cpu(bench, monkeypatch):
+    # re-probe says tpu but the resumed run adds only one more metric
+    # (tunnel wedged again): the rest must still arrive via the CPU pass,
+    # and the stall_resume log must name only what actually resumed
+    calls = _patch_recovery(
+        bench, monkeypatch,
+        probe_results=["tpu"],
+        run_outcomes={"tpu": {"conv_fleet"}, "cpu": _all_metrics(bench)},
+    )
+    detail = {}
+    errors = {"stall:lstm_fleet": "no progress"}
+    done, fell_back = bench.finish_missing_metrics(
+        {"fleet"}, detail, errors, None, 600.0
+    )
+    assert done == _all_metrics(bench)
+    assert "conv_fleet" not in fell_back  # resumed on the accelerator
+    assert "vae_fleet" in fell_back
+    assert calls["runs"] == ["tpu", "cpu"]
+    assert "conv_fleet" in errors["stall_resume"]
+    assert "vae_fleet" not in errors["stall_resume"]
+    assert "fallback" in errors
+
+
+def test_fleet_wide_is_isolated_and_bounded(bench):
+    # the knee-width rate is its own metric so a wedge there can't stall
+    # the fleet headline; quick mode (narrow windows) never runs it, the
+    # CPU fallback skips its compute, and the ratio-critical sequential
+    # metric runs immediately after the headline (window-priority order)
+    names = [n for n, _ in bench.METRICS]
+    assert "fleet_wide" in names
+    assert "fleet_wide" not in bench.QUICK_METRICS
+    assert names.index("sequential") == names.index("fleet") + 1
+    assert bench.CPU_KWARGS["fleet_wide"] == {"width": None}
+    out = bench.bench_fleet_wide(width=None)
+    assert "fleet_wide_skipped" in out
+
+
+def test_cpu_first_run_never_reprobes(bench, monkeypatch):
+    calls = _patch_recovery(
+        bench, monkeypatch, probe_results=["tpu"], run_outcomes={}
+    )
+    detail, errors = {}, {}
+    done, fell_back = bench.finish_missing_metrics(
+        {"fleet"}, detail, errors, "cpu", 600.0
+    )
+    assert calls["probes"] == 0 and calls["runs"] == []
+    assert done == {"fleet"} and fell_back == set()
