@@ -10,8 +10,11 @@ conventions) and ``IrocReader`` (facility CSV dumps). The cloud SDK is not
 available in this environment, so the store is abstracted to a *mounted*
 directory tree (``store_path``): deployments mount the lake (blobfuse,
 NFS, rsync'd snapshot, ...) and the path conventions below are preserved.
-Auth kwargs are accepted for config compatibility and recorded in
-metadata, but no network auth is performed.
+The reference's two auth modes (interactive device-code,
+service-principal ``dl_service_auth_str``) are implemented as real OAuth2
+flows in :mod:`.auth`; token acquisition is lazy, so mounted reads never
+touch the network, and secrets are kept out of captured params (use the
+``env:VARNAME`` indirection — see ``DataLakeProvider``).
 
 Offline layout (documented dialect; create with plain pandas):
 
@@ -189,9 +192,15 @@ class DataLakeProvider(GordoBaseDataProvider):
     """Dispatching facade over the lake readers (reference:
     ``DataLakeProvider`` with sub-readers selected per tag).
 
-    ``interactive`` / ``dl_service_auth_str`` are accepted for config
-    compatibility with reference-era YAML and recorded in metadata; they
-    perform no network auth here — mount the lake at ``store_path``.
+    ``interactive`` / ``dl_service_auth_str`` carry the reference's two
+    auth modes (device-code flow / service-principal string) and build a
+    real ``LakeCredential`` over the OAuth2 flows in
+    :mod:`.auth` — token acquisition is lazy, so reading a lake *mounted*
+    at ``store_path`` (the offline deployment shape) never touches the
+    network, while remote-lake transports call
+    ``provider.credential.headers()`` for a live Authorization header.
+    ``auth_transport``/``auth_kwargs`` inject the HTTP transport and flow
+    knobs (tenant/client ids for interactive; test stubs).
     """
 
     @capture_args
@@ -202,13 +211,63 @@ class DataLakeProvider(GordoBaseDataProvider):
         interactive: bool = False,
         dl_service_auth_str: Optional[str] = None,
         value_name: str = "Value",
+        auth_transport=None,
+        auth_kwargs: Optional[Dict] = None,
     ):
+        from gordo_components_tpu.dataset.data_provider.auth import (
+            credential_from_config,
+        )
+
         self.store_path = store_path
         self.asset_paths = asset_paths
-        if interactive or dl_service_auth_str:
+        # wiring, not config: transports/prompts are callables the
+        # definition language can't express — keep them out of the params
+        # the serializer re-emits
+        self._params.pop("auth_transport", None)
+        self._params.pop("auth_kwargs", None)
+        resolved_auth = dl_service_auth_str
+        if dl_service_auth_str and dl_service_auth_str.startswith("env:"):
+            # config-safe indirection: the YAML carries 'env:NAME', the
+            # secret stays in the pod environment, and _params (which the
+            # serializer re-emits into artifact metadata) never sees it
+            var = dl_service_auth_str[4:]
+            resolved_auth = os.environ.get(var)
+            if not resolved_auth:
+                raise ValueError(
+                    f"dl_service_auth_str points at env var {var!r}, "
+                    "which is unset"
+                )
+        elif dl_service_auth_str:
+            if dl_service_auth_str.endswith(":***"):
+                # this is a REDACTED string round-tripped out of artifact
+                # metadata — constructing with it would fail AAD auth far
+                # from the cause; fail loudly at the source instead
+                raise ValueError(
+                    "dl_service_auth_str is a redacted value from artifact "
+                    "metadata ('tenant:client:***'); configure the real "
+                    "secret via the 'env:VARNAME' form"
+                )
+            # a literal secret was passed: keep it out of the captured
+            # params so artifacts/metadata can't leak it (the tenant and
+            # client ids stay visible for debuggability)
+            head = ":".join(dl_service_auth_str.split(":")[:2])
+            self._params["dl_service_auth_str"] = head + ":***"
+            logger.warning(
+                "DataLakeProvider: dl_service_auth_str passed as a literal "
+                "— prefer the 'env:VARNAME' form so configs and artifact "
+                "metadata never carry the secret"
+            )
+        self.credential = credential_from_config(
+            interactive=interactive,
+            dl_service_auth_str=resolved_auth,
+            transport=auth_transport,
+            **(auth_kwargs or {}),
+        )
+        if self.credential is not None:
             logger.info(
-                "DataLakeProvider: auth options are recorded but unused — "
-                "this offline provider reads the lake mounted at %r",
+                "DataLakeProvider: %s credential configured (tokens are "
+                "acquired lazily; mounted reads at %r never trigger auth)",
+                "service-principal" if dl_service_auth_str else "device-code",
                 store_path,
             )
         self.readers: List[GordoBaseDataProvider] = [
