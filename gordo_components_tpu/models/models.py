@@ -370,8 +370,22 @@ class ConvAutoEncoder(SequenceBaseEstimator):
 
     @capture_args
     def __init__(self, kind: str = "conv1d_autoencoder", lookback_window: int = 16, **kwargs):
+        # pin the conv implementation explicitly at build time: the
+        # factory default changed once (lax -> matmul, 2026-07-31) and a
+        # trained artifact must reload with the impl its thresholds were
+        # calibrated under, not whatever the default is at load time
+        kwargs.setdefault("conv_impl", "matmul")
         super().__init__(kind=kind, lookback_window=lookback_window, **kwargs)
         self._params = {"kind": kind, "lookback_window": lookback_window, **kwargs}
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        # artifacts pickled before the impl was pinned were built under
+        # the then-default "lax"; resolve them to it so reload never
+        # flips numerics under a trained model's thresholds
+        self.factory_kwargs.setdefault("conv_impl", "lax")
+        if hasattr(self, "_params"):
+            self._params.setdefault("conv_impl", "lax")
 
     _target_offset = 0
 

@@ -57,6 +57,36 @@ def test_matmul_impl_matches_lax_bfloat16():
     np.testing.assert_allclose(out_lax, out_mm, atol=2e-2 * scale)
 
 
+def test_conv_impl_pinned_across_pickle_and_default_changes():
+    """The factory default changed once (lax -> matmul): new artifacts
+    must record their impl explicitly, and artifacts pickled BEFORE the
+    pin existed must resolve to the old 'lax' default they were trained
+    (and threshold-calibrated) under — never to the load-time default."""
+    import pickle
+
+    from gordo_components_tpu.models import ConvAutoEncoder
+
+    est = ConvAutoEncoder(channels=(4, 2), epochs=1, lookback_window=8)
+    assert est.factory_kwargs["conv_impl"] == "matmul"
+    assert est._params["conv_impl"] == "matmul"
+    X = np.random.RandomState(0).rand(64, 3).astype(np.float32)
+    est.fit(X)
+    reloaded = pickle.loads(pickle.dumps(est))
+    assert reloaded.factory_kwargs["conv_impl"] == "matmul"
+    np.testing.assert_allclose(reloaded.predict(X), est.predict(X))
+
+    # simulate a pre-pin artifact: strip the recorded impl before pickling
+    legacy = ConvAutoEncoder(channels=(4, 2), epochs=1, lookback_window=8,
+                             conv_impl="lax")
+    legacy.fit(X)
+    del legacy.factory_kwargs["conv_impl"]
+    del legacy._params["conv_impl"]
+    revived = pickle.loads(pickle.dumps(legacy))
+    assert revived.factory_kwargs["conv_impl"] == "lax"
+    assert revived._params["conv_impl"] == "lax"
+    assert revived.module.conv_impl == "lax"
+
+
 def test_bad_conv_impl_rejected():
     x = jnp.zeros((2, 16, 3), jnp.float32)
     mod = conv1d_autoencoder(3, conv_impl="LAX")
