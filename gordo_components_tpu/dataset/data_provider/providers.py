@@ -63,15 +63,17 @@ class RandomDataProvider(GordoBaseDataProvider):
         # anyway, and halving the generator's memory traffic makes each
         # tag ~1.9x faster (measured) — the synthetic generator is the
         # host-staging benchmark's provider leg, so its speed is measured.
-        # Below 2^24 samples the counter is integer-exact in f32 and the
-        # whole argument stays f32 (one fused pass). Beyond that (a
-        # 1s-freq year is 31.5M rows) f32 stops representing consecutive
-        # integers — the sine would emit stepped duplicates — so the
-        # argument is built in f64 and wrapped mod 2pi before the f32
-        # cast, which then loses only ~1e-7 rad regardless of range.
+        # The f32 fast path is bounded by ARGUMENT precision, not integer
+        # representability: at the worst-case freq (0.1) the phase reaches
+        # ~0.63*n rad, and f32 ulp grows with magnitude — at n=2^17 the
+        # argument error is ~1e-2 rad (value error ~1e-2, well under the
+        # 0.1 noise floor), but by n~1e7 it would be ~0.5 rad and the
+        # tail would stop being a sinusoid. Longer ranges build the
+        # argument in f64 wrapped mod 2pi before the f32 cast (~1e-7 rad
+        # at any length, ~1.6x slower).
         n = len(index)
         two_pi = 2 * np.pi
-        small = n < (1 << 24)
+        small = n <= (1 << 17)
         t = np.arange(n, dtype=np.float32 if small else np.float64)
         two_pi_t32 = np.float32(two_pi) * t if small else None
         for tag in tag_list:

@@ -99,6 +99,9 @@ def fused_agg_join(
     cols: List[Tuple[Any, Any, int, np.ndarray]] = []  # (name, dtype, lo, mean)
     # per-id(index) cache of the index-only window/bucket arithmetic
     index_cache: Dict[int, Tuple] = {}
+    # per-id(index) bucket counts, shared by NaN-free tags on that index
+    # (ids stay valid because index_cache pins the index objects alive)
+    count_cache: Dict[int, np.ndarray] = {}
     tz = None
     index_name = None
     units = set()  # non-nano datetime units (pandas 2.x): preserved on output
@@ -202,24 +205,41 @@ def fused_agg_join(
             # object/extension dtypes: let pandas define the behavior
             return None
         good = ~np.isnan(fvals)
+        if good.all():
+            # NaN-free (the common case): skip the two fancy-index copies,
+            # and reuse one per-index counts pass — every NaN-free tag
+            # sharing the index has identical bucket counts. The sum path
+            # never needs counts, so it skips the cache entirely.
+            o, v = offs, fvals
+            counts = None
+            if aggregation != "sum":
+                counts = count_cache.get(id(series.index))
+                if counts is None:
+                    counts = np.bincount(offs, minlength=n)
+                    count_cache[id(series.index)] = counts
+        else:
+            o, v = offs[good], fvals[good]
+            counts = None
         if aggregation == "mean":
-            counts = np.bincount(offs[good], minlength=n)
-            sums = np.bincount(offs[good], weights=fvals[good], minlength=n)
+            if counts is None:
+                counts = np.bincount(o, minlength=n)
+            sums = np.bincount(o, weights=v, minlength=n)
             with np.errstate(invalid="ignore", divide="ignore"):
                 agg = sums / counts  # count==0 -> NaN, matching pandas
         elif aggregation == "sum":
             # empty/all-NaN buckets inside the range sum to 0.0 (pandas
             # skipna with min_count=0)
-            agg = np.bincount(offs[good], weights=fvals[good], minlength=n)
+            agg = np.bincount(o, weights=v, minlength=n)
         else:  # min / max: NaN where a bucket has no real values
             fill = np.inf if aggregation == "min" else -np.inf
             agg = np.full(n, fill)
             ufunc = np.minimum if aggregation == "min" else np.maximum
-            ufunc.at(agg, offs[good], fvals[good])
+            ufunc.at(agg, o, v)
             # empty buckets -> NaN, detected by COUNT (comparing against
             # the fill sentinel would also clobber genuine +/-inf data)
-            nvals = np.bincount(offs[good], minlength=n)
-            agg[nvals == 0] = np.nan
+            if counts is None:
+                counts = np.bincount(o, minlength=n)
+            agg[counts == 0] = np.nan
         # pandas preserves float32 through these aggs; ints widen only
         # under mean (other int aggs fell back above)
         out_dtype = series.dtype if series.dtype == np.float32 else np.float64

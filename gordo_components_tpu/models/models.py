@@ -382,7 +382,11 @@ class ConvAutoEncoder(SequenceBaseEstimator):
         super().__setstate__(state)
         # artifacts pickled before the impl was pinned were built under
         # the then-default "lax"; resolve them to it so reload never
-        # flips numerics under a trained model's thresholds
+        # flips numerics under a trained model's thresholds. (Unpinned
+        # pickles from the ~1h window where the default was already
+        # matmul but the pin hadn't landed are indistinguishable and
+        # resolve to lax too — a deliberate tie-break toward the years of
+        # pre-flip artifacts; both impls agree within f32 1e-5 anyway.)
         self.factory_kwargs.setdefault("conv_impl", "lax")
         if hasattr(self, "_params"):
             self._params.setdefault("conv_impl", "lax")
