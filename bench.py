@@ -372,12 +372,18 @@ def bench_fleet_wide(
 _SWEEP_KNEE = {"width": None}
 
 
-def bench_width_sweep(widths=(256, 1024, 2048, 4096), rows=720, n_features=10,
-                      epochs=3, batch_size=128):
+def bench_width_sweep(widths=(256, 1024, 2048, 4096, 8192, 16384), rows=720,
+                      n_features=10, epochs=3, batch_size=128):
     """vmap-width -> throughput curve (VERDICT r2 weak #6): "width is the
     lever" as a measurement, not an assertion. Reports models/hour/chip at
     each width plus where the curve knees (last width whose per-model rate
-    still improved >10%)."""
+    still improved >10% — the grid keeps uniform 2x steps so that
+    threshold stays calibrated). The 2026-07-31 TPU run still gained >10%
+    at its top width (4096 -> 3.48M models/hour), so the sweep now
+    extends to 16384 — ~0.47 GB of member data at 720x10 f32,
+    comfortably inside v5e HBM — to find where the curve flattens. Each
+    width prints a progress line so the supervisor's stall watchdog
+    bounds one width's compile+fit, not the whole sweep."""
     import jax
 
     n_chips = len(jax.devices())
@@ -392,6 +398,8 @@ def bench_width_sweep(widths=(256, 1024, 2048, 4096), rows=720, n_features=10,
         members = _synth_fleet(width, rows, n_features)
         rate, _, _ = _timed_fleet_fit(config, members, n_chips)
         curve[str(width)] = round(rate, 1)
+        # any line counts as progress to the supervising parent
+        print(f"# width_sweep {width}: {rate:.0f} models/h", flush=True)
         if prev_rate is not None and rate > prev_rate * 1.1:
             knee = width
         prev_rate = rate
