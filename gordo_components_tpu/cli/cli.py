@@ -33,7 +33,13 @@ EXIT_BUILD_ERROR = 83
 @click.option("--profile-dir", default=None, envvar="GORDO_PROFILE_DIR",
               help="Write jax.profiler traces of train/build hot sections "
                    "here (TensorBoard/Perfetto-viewable)")
-def gordo(log_level, platform, profile_dir):
+@click.option("--compile-cache-dir", default=None,
+              envvar="GORDO_COMPILE_CACHE_DIR",
+              help="Persistent XLA compilation cache (a shared volume in "
+                   "pods): restarted/preempted builders and rolling server "
+                   "deploys reuse compiled programs instead of paying the "
+                   "~tens-of-seconds-per-shape XLA compile again")
+def gordo(log_level, platform, profile_dir, compile_cache_dir):
     """TPU-native gordo: build, serve, and orchestrate fleets of
     time-series anomaly-detection models."""
     logging.basicConfig(
@@ -44,6 +50,10 @@ def gordo(log_level, platform, profile_dir):
         import jax
 
         jax.config.update("jax_platforms", platform)
+    if compile_cache_dir:
+        from gordo_components_tpu.utils import enable_compile_cache
+
+        enable_compile_cache(compile_cache_dir)
     if profile_dir:
         os.environ["GORDO_PROFILE_DIR"] = profile_dir
 

@@ -9,7 +9,11 @@ be round-tripped through metadata / config definitions.
 from gordo_components_tpu.utils.capture import capture_args
 from gordo_components_tpu.utils.encoding import parquet_engine_available
 from gordo_components_tpu.utils.metadata import metadata_timestamp, package_version
-from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
+from gordo_components_tpu.utils.profiling import (
+    device_memory_stats,
+    enable_compile_cache,
+    maybe_profile,
+)
 
 __all__ = [
     "capture_args",

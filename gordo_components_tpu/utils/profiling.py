@@ -69,3 +69,27 @@ def device_memory_stats() -> Dict[str, Any]:
             if k in stats
         }
     return out
+
+
+def enable_compile_cache(cache_dir: str, min_compile_seconds: float = 1.0) -> str:
+    """Enable JAX's persistent (on-disk) XLA compilation cache.
+
+    The fleet engine already collapses gang shapes onto quantized ladders
+    (parallel/fleet.py), but each PROCESS still compiles every shape once
+    — and builder pods are routinely preempted and restarted (the
+    checkpoint-resume path), while rolling server deploys re-warm every
+    bucket. Pointing this at a shared volume makes those recompiles disk
+    reads (~tens of seconds per shape saved, measured ~34s/shape for
+    fleet programs on one CPU core). Programs cheaper than
+    ``min_compile_seconds`` stay uncached — writing them costs more than
+    recompiling. Returns the directory (created if absent).
+    """
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
+    )
+    logger.info("persistent XLA compilation cache at %s", cache_dir)
+    return cache_dir

@@ -1,6 +1,9 @@
 """Profiling/observability tests: traces only when enabled, memory stats
 shape, and the structured timing that now lands in build metadata."""
 
+import os
+
+import jax.numpy as jnp
 import numpy as np
 
 from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
@@ -68,3 +71,59 @@ def test_build_metadata_has_device_memory(tmp_path):
         },
     )
     assert "device_memory" in meta["model"]
+
+
+def test_enable_compile_cache_persists_programs(tmp_path):
+    """The persistent XLA cache must actually capture compiled programs:
+    a restarted builder pod's recompiles become disk reads. min=0 so even
+    this test's tiny program is cached."""
+    import jax
+
+    from gordo_components_tpu.utils import enable_compile_cache
+
+    cache_dir = str(tmp_path / "xla-cache")
+    try:
+        out = enable_compile_cache(cache_dir, min_compile_seconds=0.0)
+        assert out == cache_dir and os.path.isdir(cache_dir)
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+
+        @jax.jit
+        def f(x):
+            return (x @ x).sum() * 3.0
+
+        f(jnp.ones((64, 64))).block_until_ready()
+        assert len(os.listdir(cache_dir)) >= 1  # a program landed on disk
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_cli_compile_cache_option(tmp_path):
+    import jax
+    from click.testing import CliRunner
+
+    from gordo_components_tpu.cli.cli import gordo
+
+    cache_dir = str(tmp_path / "cli-cache")
+    try:
+        # any cheap subcommand exercises the group callback; workflow
+        # generate needs no devices
+        cfg = tmp_path / "fleet.yaml"
+        cfg.write_text(
+            "machines:\n"
+            "  - name: cc-m1\n"
+            "    dataset:\n"
+            "      type: RandomDataset\n"
+            "      train_start_date: 2020-01-01T00:00:00Z\n"
+            "      train_end_date: 2020-01-02T00:00:00Z\n"
+            "      tag_list: [t1, t2]\n"
+        )
+        res = CliRunner().invoke(
+            gordo,
+            ["--compile-cache-dir", cache_dir, "workflow", "generate",
+             "-f", str(cfg), "-p", "ccproj"],
+        )
+        assert res.exit_code == 0, res.output
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        assert os.path.isdir(cache_dir)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
