@@ -81,3 +81,28 @@ def sensor_frame() -> pd.DataFrame:
 @pytest.fixture(scope="session")
 def X(sensor_frame) -> np.ndarray:
     return sensor_frame.values
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compiled_program_state():
+    """Free compiled-program state at module boundaries.
+
+    The round-4 suite compiles many hundreds of XLA programs into ONE
+    pytest process (fleet buckets x shapes x families x impl A/Bs), and
+    jax's per-function executable caches are unbounded — full-suite runs
+    started segfaulting inside XLA CPU compilation ~half-way through
+    (observed 2026-07-31: 'Fatal Python error: Segmentation fault' in
+    backend_compile_and_load at test #~220, while the same test passes in
+    isolation). Clearing jax's caches (and the fleet engine's program
+    LRU, which would otherwise pin executables alive) at module teardown
+    bounds process compile-state; modules rarely share shapes, so the
+    recompile cost is near-zero.
+    """
+    yield
+    import gc
+
+    from gordo_components_tpu.parallel import fleet as fleet_mod
+
+    fleet_mod._PROGRAM_CACHE.clear()
+    jax.clear_caches()
+    gc.collect()
