@@ -22,6 +22,7 @@ import pandas as pd
 
 from gordo_components_tpu import __version__
 from gordo_components_tpu.dataset import get_dataset
+from gordo_components_tpu.models.base import score_metrics_of
 from gordo_components_tpu import serializer
 from gordo_components_tpu.utils import metadata_timestamp
 from gordo_components_tpu.utils.profiling import device_memory_stats, maybe_profile
@@ -124,32 +125,47 @@ def _pipeline_metadata(model) -> Dict[str, Any]:
     return {}
 
 
+def summarize_cv_folds(folds) -> Dict[str, Any]:
+    """Per-metric ``{mean, std, per-fold}`` summary over per-fold metric
+    dicts — one shared shape for the single-build and gang CV paths, so
+    their metadata stays key-identical (parity-tested)."""
+    out: Dict[str, Any] = {}
+    for key in folds[0] if folds else ():
+        vals = [float(f[key]) for f in folds]
+        out[key] = {
+            "mean": float(np.mean(vals)),
+            "std": float(np.std(vals)),
+            "per-fold": vals,
+        }
+    return out
+
+
 def _cross_validate(model_config, X, y, n_splits: int) -> Dict[str, Any]:
-    """TimeSeriesSplit CV scoring explained variance per fold. Each fold
-    trains a fresh instance deserialized from config (sidestepping sklearn
-    ``clone`` constraints on captured-kwargs estimators)."""
+    """TimeSeriesSplit CV recording the reference's full metric set per
+    fold (explained variance, r2, MSE, MAE — one prediction pass feeds
+    all four). Each fold trains a fresh instance deserialized from config
+    (sidestepping sklearn ``clone`` constraints on captured-kwargs
+    estimators)."""
     from sklearn.model_selection import TimeSeriesSplit
 
     Xv = X.values if hasattr(X, "values") else np.asarray(X)
     yv = None if y is None else (y.values if hasattr(y, "values") else np.asarray(y))
-    scores = []
+    folds = []
     t0 = time.time()
     for fold, (train_idx, test_idx) in enumerate(TimeSeriesSplit(n_splits=n_splits).split(Xv)):
         fold_model = serializer.from_definition(model_config)
         fold_model.fit(Xv[train_idx], None if yv is None else yv[train_idx])
-        score = fold_model.score(
-            Xv[test_idx], None if yv is None else yv[test_idx]
+        # capability dispatch: bare sklearn Pipelines/estimators (legal
+        # top-level configs) fall back to score()'s explained variance
+        metrics = score_metrics_of(
+            fold_model, Xv[test_idx], None if yv is None else yv[test_idx]
         )
-        scores.append(float(score))
-        logger.info("CV fold %d explained variance: %.4f", fold, score)
-    return {
-        "cv_duration_sec": time.time() - t0,
-        "explained-variance": {
-            "mean": float(np.mean(scores)),
-            "std": float(np.std(scores)),
-            "per-fold": scores,
-        },
-    }
+        folds.append(metrics)
+        logger.info(
+            "CV fold %d explained variance: %.4f",
+            fold, metrics["explained-variance"],
+        )
+    return {"cv_duration_sec": time.time() - t0, **summarize_cv_folds(folds)}
 
 
 def calculate_model_key(

@@ -301,26 +301,25 @@ def _score_cv_folds(
     plan_by_name: Dict[str, Tuple[List, np.ndarray]],
     fleet_models: Dict[str, Any],
 ) -> Dict[str, Dict[str, Any]]:
-    """Explained-variance per fold, scored with each fold member converted
-    to the SAME detector pipeline the single-build CV scores — metadata
-    keys identical to build_model._cross_validate."""
+    """The reference's full metric set per fold (explained variance, r2,
+    MSE, MAE), scored with each fold member converted to the SAME
+    detector pipeline the single-build CV scores — metadata keys
+    identical to build_model._cross_validate."""
+    from gordo_components_tpu.builder.build_model import summarize_cv_folds
+
     out: Dict[str, Dict[str, Any]] = {}
     for name, (splits, Xv) in plan_by_name.items():
         t0 = time.time()
-        scores = []
+        folds = []
         for fold, (_tr, te) in enumerate(splits):
             det = fleet_models[_cv_key(name, fold)].to_estimator()
-            scores.append(float(det.score(Xv[te])))
+            folds.append(det.score_metrics(Xv[te]))
         out[name] = {
             "cv_duration_sec": time.time() - t0,
             # fold training amortized inside the gang program; this wall
             # time covers only the scoring pass
             "fleet_cv": True,
-            "explained-variance": {
-                "mean": float(np.mean(scores)),
-                "std": float(np.std(scores)),
-                "per-fold": scores,
-            },
+            **summarize_cv_folds(folds),
         }
     return out
 

@@ -23,7 +23,11 @@ import numpy as np
 import pandas as pd
 
 from gordo_components_tpu.models.anomaly.base import AnomalyDetectorBase
-from gordo_components_tpu.models.base import GordoBase
+from gordo_components_tpu.models.base import (
+    GordoBase,
+    score_metrics_of,
+    transform_through_steps,
+)
 from gordo_components_tpu.ops.scaler import (
     ScalerParams,
     fit_minmax,
@@ -122,8 +126,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
     def _predict_model_space(self, X: np.ndarray) -> np.ndarray:
         est = self.base_estimator
         if hasattr(est, "steps"):
-            for _, step in est.steps[:-1]:
-                X = step.transform(X)
+            X = transform_through_steps(est, X)
             return np.asarray(est.steps[-1][1].predict(X), dtype=np.float32)
         return np.asarray(est.predict(X), dtype=np.float32)
 
@@ -163,6 +166,9 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
 
     def score(self, X, y=None) -> float:
         return self.base_estimator.score(X, y)
+
+    def score_metrics(self, X, y=None):
+        return score_metrics_of(self.base_estimator, X, y)
 
     def _check_fitted(self):
         if self.error_scaler_ is None:

@@ -45,3 +45,30 @@ class GordoBase(abc.ABC):
         from sklearn.base import BaseEstimator as _SkBase
 
         return _SkBase.__sklearn_tags__(self)
+
+
+def transform_through_steps(est, X):
+    """Apply all but the final step of an sklearn Pipeline-like object —
+    the one definition of "walk the preprocessing steps" shared by
+    prediction and scoring paths (y never transforms, matching
+    ``Pipeline.score``)."""
+    for _, step in est.steps[:-1]:
+        X = step.transform(X)
+    return X
+
+
+def score_metrics_of(est, X, y=None) -> dict:
+    """The reference's full evaluation metric set from any estimator.
+
+    Capability dispatch: native estimators implement ``score_metrics``;
+    sklearn Pipelines route through their preprocessing steps to a final
+    estimator that may; anything else falls back to ``score()`` (the
+    universal sklearn surface), recording explained variance only.
+    """
+    if hasattr(est, "score_metrics"):
+        return est.score_metrics(X, y)
+    if hasattr(est, "steps"):
+        final = est.steps[-1][1]
+        if hasattr(final, "score_metrics"):
+            return final.score_metrics(transform_through_steps(est, X), y)
+    return {"explained-variance": float(est.score(X, y))}

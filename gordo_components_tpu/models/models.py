@@ -25,7 +25,7 @@ from gordo_components_tpu.models.base import GordoBase
 from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.models import factories  # noqa: F401 — registers factories
 from gordo_components_tpu.models import train_core
-from gordo_components_tpu.ops.losses import explained_variance
+from gordo_components_tpu.ops.losses import explained_variance, regression_metrics
 from gordo_components_tpu.utils import capture_args
 
 logger = logging.getLogger(__name__)
@@ -249,13 +249,27 @@ class BaseEstimator(GordoBase):
     def transform(self, X) -> np.ndarray:
         return self.predict(X)
 
+    def _scoring_pair(self, X, y):
+        """(aligned target, prediction) — the single definition of scoring
+        alignment, shared by ``score`` and ``score_metrics`` (sequence
+        estimators override to drop the lookback warm-up rows)."""
+        X = _as_float32(X)
+        target = X if y is None else _as_float32(y)
+        return target, self.predict(X)
+
     def score(self, X, y=None) -> float:
         """Explained variance of the reconstruction (reference semantics)."""
         self._check_fitted()
-        X = _as_float32(X)
-        target = X if y is None else _as_float32(y)
-        pred = self.predict(X)
+        target, pred = self._scoring_pair(X, y)
         return float(explained_variance(jnp.asarray(target), jnp.asarray(pred)))
+
+    def score_metrics(self, X, y=None) -> Dict[str, float]:
+        """The reference's full evaluation metric set (explained variance,
+        r2, MSE, MAE) with ``score``'s exact target alignment — one
+        prediction pass feeds all four."""
+        self._check_fitted()
+        target, pred = self._scoring_pair(X, y)
+        return regression_metrics(jnp.asarray(target), jnp.asarray(pred))
 
     def get_metadata(self) -> Dict[str, Any]:
         md: Dict[str, Any] = {
@@ -339,13 +353,11 @@ class SequenceBaseEstimator(BaseEstimator):
         W = self._window_inputs(X)
         return train_core.batched_apply(self.module, self.params_, W)
 
-    def score(self, X, y=None) -> float:
-        self._check_fitted()
+    def _scoring_pair(self, X, y):
         X = _as_float32(X)
         base = X if y is None else _as_float32(y)
         target = base[self.lookback_window - 1 + self._target_offset :]
-        pred = self.predict(X)
-        return float(explained_variance(jnp.asarray(target), jnp.asarray(pred)))
+        return target, self.predict(X)
 
 
 class LSTMAutoEncoder(SequenceBaseEstimator):

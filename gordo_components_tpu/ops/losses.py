@@ -23,11 +23,37 @@ def mse_loss(pred: jnp.ndarray, target: jnp.ndarray, mask: Optional[jnp.ndarray]
     return jnp.sum(err * mask_b) / denom
 
 
+def _ratio_score(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """sklearn's 0/0 convention for variance-ratio scores: 1 - num/den,
+    but a zero-variance output scores 1.0 when predicted perfectly
+    (num == 0) and 0.0 otherwise."""
+    safe = jnp.where(den > 0, den, 1.0)
+    return jnp.where(
+        den > 0, 1.0 - num / safe, jnp.where(num == 0, 1.0, 0.0)
+    )
+
+
 def explained_variance(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> jnp.ndarray:
     """Uniform-average explained variance, matching
-    ``sklearn.metrics.explained_variance_score`` defaults."""
+    ``sklearn.metrics.explained_variance_score`` defaults (including the
+    0/0 -> 1.0 constant-column convention)."""
     diff = y_true - y_pred
     num = jnp.var(diff - jnp.mean(diff, axis=0), axis=0)
     den = jnp.var(y_true - jnp.mean(y_true, axis=0), axis=0)
-    ev = jnp.where(den > 0, 1.0 - num / jnp.where(den > 0, den, 1.0), 0.0)
-    return jnp.mean(ev)
+    return jnp.mean(_ratio_score(num, den))
+
+
+def regression_metrics(y_true: jnp.ndarray, y_pred: jnp.ndarray) -> dict:
+    """The reference's evaluation metric set, uniform-averaged over
+    outputs with sklearn-default semantics: explained variance, r2, MSE,
+    MAE. One pass over (rows, features) arrays; returned as python
+    floats for metadata."""
+    diff = y_true - y_pred
+    mse_per = jnp.mean(diff**2, axis=0)
+    den = jnp.var(y_true - jnp.mean(y_true, axis=0), axis=0)
+    return {
+        "explained-variance": float(explained_variance(y_true, y_pred)),
+        "r2-score": float(jnp.mean(_ratio_score(mse_per, den))),
+        "mean-squared-error": float(jnp.mean(mse_per)),
+        "mean-absolute-error": float(jnp.mean(jnp.abs(diff))),
+    }

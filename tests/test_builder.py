@@ -4,6 +4,7 @@
 import json
 import os
 
+import numpy as np
 import pytest
 
 from gordo_components_tpu import serializer
@@ -48,7 +49,35 @@ class TestBuildModel:
             evaluation_config={"cross_validation": True, "n_splits": 2},
         )
         cv = md["model"]["cross-validation"]
+        # the reference's full evaluation metric set, per fold
+        for metric in ("explained-variance", "r2-score",
+                       "mean-squared-error", "mean-absolute-error"):
+            assert len(cv[metric]["per-fold"]) == 2
+            assert cv[metric]["mean"] == pytest.approx(
+                np.mean(cv[metric]["per-fold"])
+            )
+        assert cv["mean-squared-error"]["mean"] >= 0
+        assert cv["mean-absolute-error"]["mean"] >= 0
+
+    def test_cross_validation_bare_sklearn_pipeline_falls_back(self):
+        """A top-level sklearn Pipeline is a legal config; it has no
+        score_metrics, so CV must fall back to score()'s explained
+        variance instead of crashing."""
+        _, md = build_model(
+            "m",
+            {"sklearn.pipeline.Pipeline": {"steps": [
+                "sklearn.preprocessing.MinMaxScaler",
+                {"gordo_components_tpu.models.AutoEncoder": {
+                    "epochs": 1, "batch_size": 32}},
+            ]}},
+            DATA_CONFIG,
+            evaluation_config={"cross_validation": True, "n_splits": 2},
+        )
+        cv = md["model"]["cross-validation"]
+        # the Pipeline routes to the final estimator, which DOES have
+        # score_metrics — the full set arrives through the steps walk
         assert len(cv["explained-variance"]["per-fold"]) == 2
+        assert "r2-score" in cv
 
     def test_cross_val_only_skips_training(self):
         _, md = build_model(

@@ -69,6 +69,11 @@ class TestGangCV:
             assert cv["fleet_cv"] is True
             assert np.isfinite(ev["per-fold"]).all()
             assert ev["mean"] == pytest.approx(np.mean(ev["per-fold"]))
+            # gang CV carries the same full metric set as single builds
+            for metric in ("r2-score", "mean-squared-error",
+                           "mean-absolute-error"):
+                assert len(cv[metric]["per-fold"]) == 3
+                assert np.isfinite(cv[metric]["per-fold"]).all()
 
         # parity: the same machine single-built records fold scores the
         # gang path must match (same splits, same data, same estimator

@@ -100,6 +100,67 @@ class TestAutoEncoder:
         model.fit(sensor_frame)
         assert model.predict(sensor_frame).shape == sensor_frame.shape
 
+    def test_score_metrics_matches_sklearn(self, X):
+        """score_metrics is the reference's evaluation metric set; each
+        value must match sklearn computed on the SAME (target, pred) pair
+        — including the sequence families' lookback alignment."""
+        import sklearn.metrics as skm
+
+        for model in (
+            AutoEncoder(**FAST),
+            LSTMAutoEncoder(kind="lstm_symmetric", dims=(8,),
+                            lookback_window=6, **FAST),
+        ):
+            model.fit(X)
+            out = model.score_metrics(X)
+            pred = np.asarray(model.predict(X), np.float64)
+            target = np.asarray(X, np.float64)
+            if pred.shape[0] != target.shape[0]:  # sequence alignment
+                target = target[target.shape[0] - pred.shape[0]:]
+            assert out["explained-variance"] == pytest.approx(
+                skm.explained_variance_score(target, pred), abs=1e-5
+            )
+            assert out["r2-score"] == pytest.approx(
+                skm.r2_score(target, pred, multioutput="uniform_average"),
+                abs=1e-5,
+            )
+            assert out["mean-squared-error"] == pytest.approx(
+                skm.mean_squared_error(target, pred), abs=1e-5
+            )
+            assert out["mean-absolute-error"] == pytest.approx(
+                skm.mean_absolute_error(target, pred), abs=1e-5
+            )
+            assert out["explained-variance"] == pytest.approx(
+                model.score(X), abs=1e-6
+            )
+
+    def test_regression_metrics_constant_column_convention(self):
+        """sklearn's 0/0 rule: a zero-variance output predicted perfectly
+        scores 1.0 (not 0.0) in r2/explained variance — a stuck sensor
+        reconstructed exactly must not drag the recorded CV metrics."""
+        import sklearn.metrics as skm
+
+        from gordo_components_tpu.ops.losses import regression_metrics
+
+        rng = np.random.RandomState(0)
+        y = np.c_[np.full(50, 3.0), rng.rand(50)].astype(np.float64)
+        pred = y.copy()
+        pred[:, 1] += rng.normal(scale=0.1, size=50)
+        out = regression_metrics(y, pred)
+        assert out["r2-score"] == pytest.approx(
+            skm.r2_score(y, pred, multioutput="uniform_average"), abs=1e-6
+        )
+        assert out["explained-variance"] == pytest.approx(
+            skm.explained_variance_score(y, pred), abs=1e-6
+        )
+        # and an imperfect constant-column prediction scores 0 for it
+        pred2 = pred.copy()
+        pred2[:, 0] += 0.5
+        out2 = regression_metrics(y, pred2)
+        assert out2["r2-score"] == pytest.approx(
+            skm.r2_score(y, pred2, multioutput="uniform_average"), abs=1e-6
+        )
+
 
 class TestSequenceModels:
     @pytest.mark.parametrize("kind", ["lstm_model", "lstm_symmetric", "lstm_hourglass"])
