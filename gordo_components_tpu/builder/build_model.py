@@ -13,7 +13,6 @@ import hashlib
 import json
 import logging
 import os
-import pickle
 import time
 from typing import Any, Dict, Optional, Tuple
 
