@@ -21,4 +21,4 @@ survey time, so citations are of the form ``gordo_components/<path>
 __version__ = "0.2.0"
 
 MAJOR_VERSION = 0
-MINOR_VERSION = 1
+MINOR_VERSION = 2

@@ -101,6 +101,18 @@ async def list_models(request: web.Request) -> web.Response:
     return web.json_response(body)
 
 
+@routes.get("/gordo/v0/{project}/ready")
+async def readiness(request: web.Request) -> web.Response:
+    """O(1) readiness: the K8s probe fires every few seconds, and
+    ``/models`` returns the full name list + per-model bank coverage —
+    ~1 MB per probe at the 10k north star. This returns counts only;
+    503 until the collection has loaded at least one model (matching
+    the probe's previous effective gate on ``/models``)."""
+    n = len(_collection(request).models)
+    body = {"ready": n > 0, "models": n}
+    return web.json_response(body, status=200 if n > 0 else 503)
+
+
 @routes.get("/gordo/v0/{project}/stats")
 async def server_stats(request: web.Request) -> web.Response:
     """Serving-process observability (SURVEY.md §5 metrics): request

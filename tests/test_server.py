@@ -47,6 +47,23 @@ def _x_payload(n=20, f=3):
     return {"X": rng.rand(n, f).tolist()}
 
 
+async def test_readiness_is_count_only(artifact_dir):
+    """The K8s probe hits /ready every few seconds; it must be O(1)
+    (counts, not the 10k-name + bank-coverage body of /models) and 503
+    when the collection holds no models (every artifact removed by a
+    refresh — empty-at-startup is rejected earlier by build_app)."""
+    async with make_client(artifact_dir) as client:
+        resp = await client.get("/gordo/v0/proj/ready")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body == {"ready": True, "models": 2}
+        # all models gone (refresh removed them): not ready
+        client.app["collection"]._state = ({}, {})
+        resp = await client.get("/gordo/v0/proj/ready")
+        assert resp.status == 503
+        assert (await resp.json())["ready"] is False
+
+
 async def test_list_models(artifact_dir):
     async with make_client(artifact_dir) as client:
         resp = await client.get("/gordo/v0/proj/models")
