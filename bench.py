@@ -1264,7 +1264,8 @@ def finish_missing_metrics(done, detail, errors, env_platform, budget):
     set of metrics whose numbers came from the CPU fallback — ratio
     bookkeeping (vs_baseline, MFU) must exclude those.
     """
-    missing = {n for n, _ in METRICS} - done
+    all_names = {n for n, _ in METRICS}
+    missing = all_names - done
     fell_back: set = set()
     if missing and env_platform != "cpu":
         re_platform, _, _, re_attempts = probe_backend(
@@ -1282,7 +1283,7 @@ def finish_missing_metrics(done, detail, errors, env_platform, budget):
                 k.split(":", 1)[1]
                 for k in errors
                 if k.startswith(("stall:", "crashed:"))
-            }
+            } & all_names  # drop the 'stall:?' no-metric-started sentinel
             # pin the flavor that actually answered: on this box the
             # 'tpu' pin and default resolution fail independently, and
             # resuming via the dead flavor would hang in backend init
@@ -1306,7 +1307,7 @@ def finish_missing_metrics(done, detail, errors, env_platform, budget):
                     f"metrics {resumed} resumed on {re_platform} after a "
                     "stall + successful re-probe"
                 )
-            missing = {n for n, _ in METRICS} - done
+            missing = all_names - done
     if missing and env_platform != "cpu":
         errors["fallback"] = (
             f"metrics {sorted(missing)} re-run on CPU after accelerator stall"

@@ -21,7 +21,9 @@ is built from them.
 
 import json
 import logging
+import threading
 import time
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Callable, Dict, Optional
@@ -217,14 +219,19 @@ class LakeCredential:
         self.flow = flow
         self.clock = clock
         self._token: Optional[Token] = None
+        # staging worker threads share one credential; without the lock,
+        # concurrent callers seeing an expired token would each run the
+        # flow (and a DeviceCodeFlow would prompt the operator twice)
+        self._lock = threading.Lock()
 
     def get_token(self) -> str:
-        if self._token is None or self._token.expired(self.clock()):
-            refreshing = self._token is not None
-            self._token = self.flow.acquire()
-            if refreshing:
-                logger.info("lake credential refreshed before expiry")
-        return self._token.access_token
+        with self._lock:
+            if self._token is None or self._token.expired(self.clock()):
+                refreshing = self._token is not None
+                self._token = self.flow.acquire()
+                if refreshing:
+                    logger.info("lake credential refreshed before expiry")
+            return self._token.access_token
 
     def headers(self) -> Dict[str, str]:
         return {"Authorization": f"Bearer {self.get_token()}"}

@@ -105,7 +105,7 @@ async def list_models(request: web.Request) -> web.Response:
 async def readiness(request: web.Request) -> web.Response:
     """O(1) readiness: the K8s probe fires every few seconds, and
     ``/models`` returns the full name list + per-model bank coverage —
-    ~1 MB per probe at the 10k north star. This returns counts only;
+    ~340 KB per probe at the 10k north star. This returns counts only;
     503 until the collection has loaded at least one model (matching
     the probe's previous effective gate on ``/models``)."""
     n = len(_collection(request).models)
