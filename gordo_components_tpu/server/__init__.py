@@ -70,6 +70,7 @@ def build_app(
     use_bank: Optional[bool] = None,
     bank_flush_ms: float = 2.0,
     bank_max_batch: int = 64,
+    devices: Optional[int] = None,
 ) -> web.Application:
     """App factory: loads the artifact(s) under ``model_dir`` once.
 
@@ -77,9 +78,40 @@ def build_app(
     bankable model is additionally stacked into an HBM-resident
     :class:`ModelBank` and requests are continuously batched through it;
     non-bankable models keep the per-model scoring path.
+
+    ``devices`` (default: env ``GORDO_SERVER_DEVICES``; 0/unset = all
+    available when >1, else single-device) shards the bank over a
+    ``models``-axis mesh so a multi-chip server slice holds each model
+    once and routes requests to the owning chip — the layout the
+    generated manifests' ``server_devices`` request assumes.
     """
     if use_bank is None:
         use_bank = os.environ.get("GORDO_SERVER_BANK", "1") != "0"
+    if devices is None:
+        raw = os.environ.get("GORDO_SERVER_DEVICES", "0")
+        try:
+            devices = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"GORDO_SERVER_DEVICES must be an integer, got {raw!r} "
+                "(0/unset = all available devices)"
+            ) from None
+    mesh = None
+    if use_bank and devices != 1:
+        import jax
+
+        from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+        avail = len(jax.devices())
+        want = avail if devices in (0, -1) else min(devices, avail)
+        if devices > avail:
+            logger.warning(
+                "GORDO_SERVER_DEVICES=%d but only %d device(s) present; "
+                "sharding the bank over %d",
+                devices, avail, want,
+            )
+        if want > 1:
+            mesh = fleet_mesh(want)
     app = web.Application(
         client_max_size=256 * 1024**2, middlewares=[_stats_middleware]
     )
@@ -93,8 +125,9 @@ def build_app(
     app["collection"] = collection
     app["bank_enabled"] = use_bank
     app["bank_config"] = {"max_batch": bank_max_batch, "flush_ms": bank_flush_ms}
+    app["bank_mesh"] = mesh  # reload (views.py) rebuilds under the same mesh
     if use_bank:
-        bank = ModelBank.from_models(collection.models)
+        bank = ModelBank.from_models(collection.models, mesh=mesh)
         # expose the bank even when nothing banked: /models reports the
         # coverage (banked vs per-model fallback, with reasons)
         app["bank"] = bank

@@ -1,4 +1,4 @@
-"""HBM-resident model bank: many models, one device, one compiled program.
+"""HBM-resident model bank: many models, one compiled program per bucket.
 
 The reference serves one model per Flask process (gordo_components/server,
 unverified; SURVEY.md §2 "server") — scoring N machines means N processes
@@ -185,7 +185,21 @@ class _Bucket:
     Sequence models bank too: windowing runs in-graph
     (``ops/windows.sliding_windows``) with the bucket's static lookback,
     and outputs carry the warm-up ``offset`` (output row i <- input row
-    i + offset), exactly like the per-model path."""
+    i + offset), exactly like the per-model path.
+
+    With a ``mesh`` (1-D ``models`` axis, ``parallel/mesh.py``), the
+    stacked params/scalers are placed under a ``NamedSharding`` on their
+    leading (model) axis — the same layout ``FleetTrainer`` trains under
+    (``parallel/fleet.py``) — so a D-chip server holds each model's
+    weights exactly once. Requests are ROUTED: the host groups chunks by
+    the shard that owns their model (the leading axis is split into D
+    contiguous blocks), and a ``shard_map`` program scores each device's
+    sub-batch against its local params with NO collectives — per-request
+    compute stays local to the shard that owns the model, the total FLOPs
+    equal the single-device program's, and the only cross-device traffic
+    is the result fetch. (The alternative — replicating every request to
+    all devices and masking — costs D× the FLOPs; routing costs one
+    host-side groupby.)"""
 
     def __init__(
         self,
@@ -196,6 +210,7 @@ class _Bucket:
         registry_type: str = "AutoEncoder",
         lookback: int = 1,
         target_offset: int = 0,
+        mesh=None,
     ):
         self.kind = kind
         self.n_features = n_features
@@ -204,12 +219,15 @@ class _Bucket:
         self.registry_type = registry_type
         self.lookback = int(lookback)
         self.target_offset = int(target_offset)
+        self.mesh = mesh
         self.names: List[str] = []
         self._entries: List[_BankEntry] = []
         # device state, built by finalize()
         self.params = None
         self.scalers = None  # (in_shift, in_scale, err_shift, err_scale)
         self._score = None
+        self.n_shards = 1  # mesh model-axis size after finalize()
+        self.shard_size = 0  # models per shard (padded stack / n_shards)
 
     @property
     def offset(self) -> int:
@@ -220,12 +238,30 @@ class _Bucket:
         self.names.append(entry.name)
 
     def finalize(self) -> None:
+        entries = self._entries
+        sharding = None
+        if self.mesh is not None:
+            from gordo_components_tpu.parallel.mesh import (
+                MODEL_AXIS,
+                pad_count_to_mesh,
+                shard_model_axis,
+            )
+
+            self.n_shards = int(self.mesh.shape[MODEL_AXIS])
+            # the leading axis must divide the mesh: pad by repeating the
+            # last entry (real params — zero-padding would still be
+            # correct, since no routed slot ever points at a pad row, but
+            # repeats keep every row's numerics in-distribution)
+            n_pad = pad_count_to_mesh(len(entries), self.mesh)
+            entries = entries + [entries[-1]] * (n_pad - len(entries))
+            self.shard_size = n_pad // self.n_shards
+            sharding = shard_model_axis(self.mesh)
         stacked = jax.tree.map(
-            lambda *leaves: np.stack(leaves), *[e.params for e in self._entries]
+            lambda *leaves: np.stack(leaves), *[e.params for e in entries]
         )
-        self.params = jax.device_put(stacked)
+        self.params = jax.device_put(stacked, sharding)
         self.scalers = tuple(
-            jax.device_put(np.stack([getattr(e, f) for e in self._entries]))
+            jax.device_put(np.stack([getattr(e, f) for e in entries]), sharding)
             for f in ("in_shift", "in_scale", "err_shift", "err_scale")
         )
         module = lookup_factory(self.registry_type, self.kind)(
@@ -233,41 +269,95 @@ class _Bucket:
         )
         lookback, t_off, off = self.lookback, self.target_offset, self.offset
 
-        def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
-            # idx: (B,) int32; X/Y: (B, T, F) raw-space
+        def one(params, in_shift, in_scale, err_shift, err_scale, i, x, y):
+            # i: () int32 into the (local) stack; x/y: (T, F) raw-space
             from gordo_components_tpu.ops.pallas_score import _jnp_score
             from gordo_components_tpu.ops.windows import sliding_windows
 
-            def one(i, x, y):
-                p = jax.tree.map(lambda a: a[i], params)
-                xs = (x - in_shift[i]) * in_scale[i]
-                ys = (y - in_shift[i]) * in_scale[i]
-                if lookback > 1:
-                    W = sliding_windows(xs, lookback)
-                    if t_off:
-                        W = W[:-t_off]
-                    recon = module.apply(p, W)  # (T - off, F)
-                    target = ys[off : off + recon.shape[0]]
-                else:
-                    recon = module.apply(p, xs)
-                    target = ys
-                # same epilogue definition as the per-model path (XLA fuses
-                # it into the batched program here; see ops/pallas_score.py)
-                diff, scaled, tot_u, tot_s = _jnp_score(
-                    target, recon, err_shift[i], err_scale[i]
-                )
-                return recon, diff, scaled, tot_u, tot_s
+            p = jax.tree.map(lambda a: a[i], params)
+            xs = (x - in_shift[i]) * in_scale[i]
+            ys = (y - in_shift[i]) * in_scale[i]
+            if lookback > 1:
+                W = sliding_windows(xs, lookback)
+                if t_off:
+                    W = W[:-t_off]
+                recon = module.apply(p, W)  # (T - off, F)
+                target = ys[off : off + recon.shape[0]]
+            else:
+                recon = module.apply(p, xs)
+                target = ys
+            # same epilogue definition as the per-model path (XLA fuses
+            # it into the batched program here; see ops/pallas_score.py)
+            diff, scaled, tot_u, tot_s = _jnp_score(
+                target, recon, err_shift[i], err_scale[i]
+            )
+            return recon, diff, scaled, tot_u, tot_s
 
-            return jax.vmap(one)(idx, X, Y)
+        if self.mesh is None:
+
+            def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
+                # idx: (B,) int32; X/Y: (B, T, F) raw-space
+                return jax.vmap(
+                    lambda i, x, y: one(
+                        params, in_shift, in_scale, err_shift, err_scale, i, x, y
+                    )
+                )(idx, X, Y)
+
+        else:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from gordo_components_tpu.parallel.mesh import MODEL_AXIS
+
+            spec = P(MODEL_AXIS)
+
+            def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
+                # idx: (D, Blocal) LOCAL indices; X/Y: (D, Blocal, T, F);
+                # leading axis sharded over the mesh — each device scores
+                # its own sub-batch against its local (shard_size, ...)
+                # params block; no collectives
+                def local(p, ish, isc, esh, esc, i, x, y):
+                    out = jax.vmap(
+                        lambda ii, xx, yy: one(p, ish, isc, esh, esc, ii, xx, yy)
+                    )(i[0], x[0], y[0])
+                    return jax.tree.map(lambda t: t[None], out)
+
+                # check_vma off: the program is collective-free by design
+                # (every output row depends only on the local shard), and
+                # the varying-axes checker rejects the LSTM scan's
+                # unvarying initial carry under a varying input
+                return shard_map(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(spec,) * 8,
+                    out_specs=spec,
+                    check_vma=False,
+                )(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y)
 
         self._score = jax.jit(score)
         self._entries = []  # host copies no longer needed
 
     def score_batch(self, indices: np.ndarray, X: np.ndarray, Y: np.ndarray):
-        """indices: (B,), X/Y: (B, T, F) — already padded to pow2 B and T."""
+        """Single-device path. indices: (B,), X/Y: (B, T, F) — already
+        padded to pow2 B and T."""
         return self._score(
             self.params, *self.scalers, jnp.asarray(indices), jnp.asarray(X),
             jnp.asarray(Y),
+        )
+
+    def score_batch_sharded(self, indices: np.ndarray, X: np.ndarray, Y: np.ndarray):
+        """Mesh path. indices: (D, Blocal) LOCAL indices (into each
+        device's shard), X/Y: (D, Blocal, T, F), routed by the caller so
+        row d only references models owned by shard d."""
+        from gordo_components_tpu.parallel.mesh import shard_model_axis
+
+        sh = shard_model_axis(self.mesh)
+        return self._score(
+            self.params,
+            *self.scalers,
+            jax.device_put(np.ascontiguousarray(indices), sh),
+            jax.device_put(np.ascontiguousarray(X), sh),
+            jax.device_put(np.ascontiguousarray(Y), sh),
         )
 
 
@@ -311,10 +401,17 @@ class ScoreResult:
 
 
 class ModelBank:
-    """Stacked scoring bank over a model collection (HBM-resident)."""
+    """Stacked scoring bank over a model collection (HBM-resident).
 
-    def __init__(self, max_rows_per_call: int = 8192):
+    ``mesh`` (optional, a 1-D ``models``-axis mesh from
+    ``parallel/mesh.fleet_mesh``) shards every bucket's stacked state over
+    the devices and routes requests to the owning shard — see
+    :class:`_Bucket`. Without it the bank is single-device, exactly as
+    before."""
+
+    def __init__(self, max_rows_per_call: int = 8192, mesh=None):
         self.max_rows = int(max_rows_per_call)
+        self.mesh = mesh
         self._buckets: Dict[str, _Bucket] = {}
         self._index: Dict[str, Tuple[str, int]] = {}  # name -> (bucket_key, i)
         self._tags: Dict[str, List[str]] = {}
@@ -366,6 +463,7 @@ class ModelBank:
                     registry_type=entry.registry_type,
                     lookback=entry.lookback,
                     target_offset=entry.target_offset,
+                    mesh=bank.mesh,
                 )
             bank._index[name] = (key, len(bucket.names))
             bucket.add(entry)
@@ -376,11 +474,20 @@ class ModelBank:
         for bucket in bank._buckets.values():
             bucket.finalize()
         if bank._index:
-            logger.info(
-                "Model bank: %d models in %d bucket(s)",
-                len(bank._index),
-                len(bank._buckets),
-            )
+            if bank.mesh is not None:
+                logger.info(
+                    "Model bank: %d models in %d bucket(s), sharded over "
+                    "%d device(s)",
+                    len(bank._index),
+                    len(bank._buckets),
+                    bank.mesh.devices.size,
+                )
+            else:
+                logger.info(
+                    "Model bank: %d models in %d bucket(s)",
+                    len(bank._index),
+                    len(bank._buckets),
+                )
         # coverage is an operator signal: at 10k models a DEBUG line per
         # fallback is invisible — surface the aggregate loudly (and per
         # model through /models; see views.list_models)
@@ -413,9 +520,14 @@ class ModelBank:
         warmed = 0
         for bucket in self._buckets.values():
             T = max(_next_pow2(rows), _next_pow2(bucket.offset + 1))
-            X = np.zeros((1, T, bucket.n_features), np.float32)
             try:
-                bucket.score_batch(np.zeros((1,), np.int32), X, X)
+                if self.mesh is None:
+                    X = np.zeros((1, T, bucket.n_features), np.float32)
+                    bucket.score_batch(np.zeros((1,), np.int32), X, X)
+                else:
+                    D = bucket.n_shards
+                    X = np.zeros((D, 1, T, bucket.n_features), np.float32)
+                    bucket.score_batch_sharded(np.zeros((D, 1), np.int32), X, X)
                 warmed += 1
             except Exception:
                 logger.warning(
@@ -504,22 +616,42 @@ class ModelBank:
                     chunks.append(
                         (ri, start, X[start : start + T], Y[start : start + T])
                     )
-            B = _next_pow2(len(chunks))
-            Xb = np.zeros((B, T, F), np.float32)
-            Yb = np.zeros((B, T, F), np.float32)
-            idx = np.zeros((B,), np.int32)
-            for ci, (ri, _start, xc, yc) in enumerate(chunks):
-                Xb[ci, : xc.shape[0]] = xc
-                Yb[ci, : yc.shape[0]] = yc
-                idx[ci] = self._index[requests[ri][0]][1]
-            recon, diff, scaled, tot_u, tot_s = bucket.score_batch(idx, Xb, Yb)
-            recon, diff, scaled, tot_u, tot_s = (
-                np.asarray(recon),
-                np.asarray(diff),
-                np.asarray(scaled),
-                np.asarray(tot_u),
-                np.asarray(tot_s),
-            )
+            # slots[ci]: where chunk ci landed in the batched output —
+            # a flat index (single-device) or a (device, local-slot) pair
+            # (mesh routing)
+            slots: Dict[int, Any] = {}
+            if self.mesh is None:
+                B = _next_pow2(len(chunks))
+                Xb = np.zeros((B, T, F), np.float32)
+                Yb = np.zeros((B, T, F), np.float32)
+                idx = np.zeros((B,), np.int32)
+                for ci, (ri, _start, xc, yc) in enumerate(chunks):
+                    Xb[ci, : xc.shape[0]] = xc
+                    Yb[ci, : yc.shape[0]] = yc
+                    idx[ci] = self._index[requests[ri][0]][1]
+                    slots[ci] = ci
+                out = bucket.score_batch(idx, Xb, Yb)
+            else:
+                # route each chunk to the shard owning its model: the
+                # stacked leading axis is split into n_shards contiguous
+                # blocks of shard_size (parallel/mesh.shard_model_axis)
+                D, shard = bucket.n_shards, bucket.shard_size
+                per_dev: List[List[int]] = [[] for _ in range(D)]
+                for ci, (ri, _start, _xc, _yc) in enumerate(chunks):
+                    per_dev[self._index[requests[ri][0]][1] // shard].append(ci)
+                Bl = _next_pow2(max(1, max(len(c) for c in per_dev)))
+                Xb = np.zeros((D, Bl, T, F), np.float32)
+                Yb = np.zeros((D, Bl, T, F), np.float32)
+                idx = np.zeros((D, Bl), np.int32)
+                for d, cis in enumerate(per_dev):
+                    for j, ci in enumerate(cis):
+                        ri, _start, xc, yc = chunks[ci]
+                        Xb[d, j, : xc.shape[0]] = xc
+                        Yb[d, j, : yc.shape[0]] = yc
+                        idx[d, j] = self._index[requests[ri][0]][1] - d * shard
+                        slots[ci] = (d, j)
+                out = bucket.score_batch_sharded(idx, Xb, Yb)
+            recon, diff, scaled, tot_u, tot_s = (np.asarray(a) for a in out)
             # reassemble per-request: each chunk contributes its VALID
             # output rows (rows computed from real, unpadded input)
             per_req: Dict[int, List[int]] = {}
@@ -531,7 +663,7 @@ class ModelBank:
                 name, X, _yv = requests[ri]
                 n_out = X.shape[0] - off
                 cat = lambda arr: np.concatenate(
-                    [arr[ci][: valid[ci]] for ci in cis], axis=0
+                    [arr[slots[ci]][: valid[ci]] for ci in cis], axis=0
                 )[:n_out]
                 results[ri] = ScoreResult(
                     tags=self._tags[name],

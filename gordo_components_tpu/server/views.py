@@ -227,8 +227,15 @@ async def reload_models(request: web.Request) -> web.Response:
         if app.get("bank_enabled"):
             from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 
+            import functools
+
             bank = await loop.run_in_executor(
-                None, ModelBank.from_models, collection.models
+                None,
+                functools.partial(
+                    ModelBank.from_models,
+                    collection.models,
+                    mesh=app.get("bank_mesh"),
+                ),
             )
             # the rebuilt bank's jit closures are cold: re-warm them here,
             # inside the reload (still behind the single-flight lock, off

@@ -60,6 +60,12 @@ def generate_workflow(
         raise ValueError(
             f"load_workers must be 'auto' or an integer, got {params['load_workers']!r}"
         )
+    # server_devices lands in every server replica's GORDO_SERVER_DEVICES
+    # (and its TPU resource request) — same crashloop blast radius
+    if not str(params["server_devices"]).isdigit():
+        raise ValueError(
+            f"server_devices must be an integer, got {params['server_devices']!r}"
+        )
     gangs = schedule_gangs(
         config.machines,
         models_per_gang=int(params["models_per_gang"]),

@@ -48,9 +48,16 @@ def run_check(
     concurrency: int = 64,
     requests_per_client: int = 4,
     request_rows: int = 64,
+    devices: int = 1,
 ) -> dict:
     """The full check as a callable (bench.py runs it as a metric; the
-    CLI below wraps it). Returns the result document."""
+    CLI below wraps it). Returns the result document.
+
+    ``devices > 1`` shards the ModelBank over a ``models``-axis mesh
+    (``parallel/mesh.fleet_mesh``) and serves through the routed
+    multi-chip path — on CPU this needs
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` set before
+    jax initializes (the CLI below does this for you)."""
 
     from types import SimpleNamespace
 
@@ -58,6 +65,7 @@ def run_check(
         members=members, tags=tags, min_rows=min_rows, max_rows=max_rows,
         epochs=epochs, platform=platform, concurrency=concurrency,
         requests_per_client=requests_per_client, request_rows=request_rows,
+        devices=devices,
     )
 
     if args.platform:
@@ -111,8 +119,22 @@ def run_check(
     phase("estimators", t0)
 
     # ---- 4. bank construction (the startup Python loop) ----
+    mesh = None
+    if args.devices > 1:
+        import jax
+
+        from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+        n_avail = len(jax.devices())
+        if n_avail < args.devices:
+            raise SystemExit(
+                f"--devices {args.devices} but only {n_avail} jax device(s); "
+                "on CPU set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{args.devices} before jax initializes"
+            )
+        mesh = fleet_mesh(args.devices)
     t0 = time.time()
-    bank = ModelBank.from_models(models)
+    bank = ModelBank.from_models(models, mesh=mesh)
     bank_elapsed = time.time() - t0  # unrounded: CI-sized builds are ~ms
     phase("bank", t0)
     cov = bank.coverage()
@@ -229,7 +251,16 @@ def main() -> int:
     ap.add_argument("--concurrency", type=int, default=64)
     ap.add_argument("--requests-per-client", type=int, default=4)
     ap.add_argument("--request-rows", type=int, default=64)
+    ap.add_argument("--devices", type=int, default=1,
+                    help="shard the bank over an N-device models mesh")
     a = ap.parse_args()
+    if a.devices > 1 and (a.platform or "") == "cpu":
+        # must land before jax initializes; run_check imports jax lazily
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={a.devices}"
+            ).strip()
     print(json.dumps(run_check(**vars(a)), indent=1))
     return 0
 
