@@ -70,6 +70,7 @@ def build_app(
     use_bank: Optional[bool] = None,
     bank_flush_ms: float = 2.0,
     bank_max_batch: int = 64,
+    bank_max_queue: Optional[int] = None,
     devices: Optional[int] = None,
 ) -> web.Application:
     """App factory: loads the artifact(s) under ``model_dir`` once.
@@ -124,7 +125,21 @@ def build_app(
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
     app["bank_enabled"] = use_bank
-    app["bank_config"] = {"max_batch": bank_max_batch, "flush_ms": bank_flush_ms}
+    if bank_max_queue is None and os.environ.get("GORDO_BANK_MAX_QUEUE"):
+        # operator backpressure knob: how deep the scoring queue may grow
+        # before requests shed with 429 (default 8 * max_batch)
+        raw = os.environ["GORDO_BANK_MAX_QUEUE"]
+        try:
+            bank_max_queue = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"GORDO_BANK_MAX_QUEUE must be an integer, got {raw!r}"
+            ) from None
+    app["bank_config"] = {
+        "max_batch": bank_max_batch,
+        "flush_ms": bank_flush_ms,
+        "max_queue": bank_max_queue,
+    }
     app["bank_mesh"] = mesh  # reload (views.py) rebuilds under the same mesh
     if use_bank:
         bank = ModelBank.from_models(collection.models, mesh=mesh)
@@ -135,7 +150,10 @@ def build_app(
 
             async def _start_engine(app: web.Application) -> None:
                 engine = BatchingEngine(
-                    bank, max_batch=bank_max_batch, flush_ms=bank_flush_ms
+                    bank,
+                    max_batch=bank_max_batch,
+                    flush_ms=bank_flush_ms,
+                    max_queue=bank_max_queue,
                 )
                 engine.start()
                 app["bank_engine"] = engine

@@ -693,6 +693,20 @@ class _Pending:
     # a forgotten timestamp would record ~uptime into the histograms)
 
 
+class EngineOverloaded(Exception):
+    """The engine's queue is full: offered load exceeds capacity.
+
+    Carries ``retry_after_s`` — a drain-time estimate the HTTP layer
+    surfaces as ``Retry-After`` on its 429 (views.py)."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"scoring queue full ({depth} pending); retry in ~{retry_after_s:.1f}s"
+        )
+
+
 class BatchingEngine:
     """Coalesce concurrent scoring requests into batched bank calls.
 
@@ -701,15 +715,33 @@ class BatchingEngine:
     ``max_batch`` models' requests. XLA execution runs in a thread-pool
     executor so the event loop keeps accepting requests — continuous
     batching in the LLM-serving sense, applied to anomaly scoring.
+
+    Backpressure: the queue is bounded at ``max_queue`` (default
+    ``8 * max_batch``). When it is full, ``score()`` raises
+    :class:`EngineOverloaded` immediately instead of enqueueing — offered
+    load past capacity sheds with a 429 at the HTTP layer rather than
+    growing an unbounded queue whose every waiter times out. Sheds are
+    counted in ``stats["shed"]``.
     """
 
-    def __init__(self, bank: ModelBank, max_batch: int = 64, flush_ms: float = 2.0):
+    def __init__(
+        self,
+        bank: ModelBank,
+        max_batch: int = 64,
+        flush_ms: float = 2.0,
+        max_queue: Optional[int] = None,
+    ):
         self.bank = bank
         self.max_batch = int(max_batch)
         self.flush_s = float(flush_ms) / 1e3
+        if max_queue is None:
+            max_queue = 8 * self.max_batch
+        if int(max_queue) <= 0:
+            raise ValueError(f"max_queue must be positive, got {max_queue!r}")
+        self.max_queue = int(max_queue)
         self._queue: "asyncio.Queue[_Pending]" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
-        self.stats = {"requests": 0, "batches": 0, "max_batch_seen": 0}
+        self.stats = {"requests": 0, "batches": 0, "max_batch_seen": 0, "shed": 0}
         # the flush_ms coalescing window trades latency for throughput;
         # these histograms quantify that trade (VERDICT r3 next #4):
         # queue_wait = submit -> batch dispatch, service = submit -> result
@@ -735,6 +767,27 @@ class BatchingEngine:
         self, name: str, X: np.ndarray, y: Optional[np.ndarray] = None
     ) -> ScoreResult:
         self.start()
+        depth = self._queue.qsize()
+        if depth >= self.max_queue:
+            # shed NOW rather than enqueue-and-time-out: with the queue
+            # this deep, a new waiter's latency is already >= the whole
+            # backlog's service time, so the honest answer is "retry"
+            self.stats["shed"] += 1
+            # drain estimate: backlog batches x per-batch EXECUTION time.
+            # service p50 includes queue wait, which under saturation IS
+            # the backlog — subtract it or the estimate double-counts the
+            # queue and clients back off max_queue/max_batch times longer
+            # than the true drain
+            if self.service.count:
+                batch_s = max(
+                    self.service.percentile(0.5) - self.queue_wait.percentile(0.5),
+                    1e-3,
+                )
+            else:
+                batch_s = 0.05
+            raise EngineOverloaded(
+                depth, max(self.flush_s, depth / self.max_batch * batch_s)
+            )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put(_Pending(name, X, y, fut, time.monotonic()))
         return await fut

