@@ -117,15 +117,54 @@ def main():
             time.sleep(interval)
             continue
         log(f"probe {attempt}: LIVE {note} — capturing bench artifacts")
+        # an existing artifact with CPU-provenance holes gets FILLED first
+        # (priority-ordered: the sequential<->fleet pairing, bank serving,
+        # the family ratios) — the fill merges in place and survives a
+        # mid-run wedge with an explicit fill_incomplete marker, so even a
+        # narrow window advances the TPU record where it matters most
+        from bench import METRICS, artifact_tpu_metrics, latest_tpu_artifact
+
+        art_path = latest_tpu_artifact()
+        if art_path:
+            with open(art_path) as fh:
+                have = artifact_tpu_metrics(json.load(fh))
+            if len(have) < len(METRICS):
+                # the fill persists after every metric group, so this hard
+                # timeout loses at most the in-flight group
+                run_bench(["--fill", art_path], timeout=3000)
+                with open(art_path) as fh:
+                    now_have = artifact_tpu_metrics(json.load(fh))
+                log(
+                    f"fill: {len(have)} -> {len(now_have)}/{len(METRICS)} "
+                    f"TPU-provenance metrics in {os.path.basename(art_path)}"
+                )
         arts = run_bench(["--quick"], timeout=1200)
         # only attempt the hour-long full suite when the quick run proved
         # the window is real; otherwise re-arm the probe loop promptly
         if arts and os.environ.get("TPU_WATCH_SKIP_FULL") != "1":
             arts += run_bench([], timeout=3600)
-        if arts:
-            log(f"captured: {json.dumps(arts)}")
-            return 0
-        log("tunnel answered the probe but wedged during bench; re-arming")
+        # done only when the record is actually complete: the newest
+        # artifact (pre-existing and filled, or freshly captured) has
+        # every metric TPU-provenance. Partial progress (a filled group,
+        # a quick artifact) is kept on disk and the session stays armed —
+        # later windows in the remaining hours can finish the job.
+        newest = latest_tpu_artifact()
+        if newest:
+            with open(newest) as fh:
+                n_tpu = len(artifact_tpu_metrics(json.load(fh)))
+            if n_tpu == len(METRICS):
+                log(
+                    f"record complete: {os.path.basename(newest)} has all "
+                    f"{n_tpu} metrics TPU-provenance (arts={json.dumps(arts)})"
+                )
+                return 0
+            log(
+                f"window over: {os.path.basename(newest)} at "
+                f"{n_tpu}/{len(METRICS)} TPU metrics "
+                f"(arts={json.dumps(arts)}); re-arming"
+            )
+        else:
+            log("window over with no artifact; re-arming")
         time.sleep(interval)
     log("deadline reached with no TPU capture")
     return 3
