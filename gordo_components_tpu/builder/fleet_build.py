@@ -616,6 +616,11 @@ def _build_fleet_group(
                 "history": fm.history,
                 "model_builder_cache_key": key,
                 "trained": True,
+                # detector metadata (thresholds + their provenance —
+                # "exact" vs the fleet's "histogram-8192" streaming
+                # quantiles), same placement as the single-build path
+                # (build_model.py)
+                **det.get_metadata(),
             },
             "user-defined": machine.metadata,
         }

@@ -96,6 +96,13 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         self.error_scaler_: Optional[ScalerParams] = None
         self.feature_thresholds_: Optional[np.ndarray] = None
         self.total_threshold_: Optional[float] = None
+        # how the thresholds were computed: "exact" (np.quantile over
+        # materialized errors — this class's own fit) or "histogram-8192"
+        # (the fleet's streaming pass for sequence members with q < 1,
+        # error bounded by range/8192; parallel/fleet.py). Recorded in
+        # metadata so an operator comparing fleet- and single-built
+        # thresholds knows why they differ at the 4th decimal.
+        self.threshold_method_: Optional[str] = None
         self.tags_: Optional[list] = None
 
     # ------------------------------------------------------------------ #
@@ -159,6 +166,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         self.total_threshold_ = float(
             np.quantile(np.linalg.norm(scaled, axis=-1), q)
         )
+        self.threshold_method_ = "exact"
         return self
 
     def predict(self, X):
@@ -215,4 +223,5 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
                 t: float(v) for t, v in zip(self.tags_ or [], self.feature_thresholds_)
             }
             md["total-anomaly-threshold"] = self.total_threshold_
+            md["threshold-method"] = self.threshold_method_ or "exact"
         return md

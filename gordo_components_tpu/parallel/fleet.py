@@ -213,6 +213,16 @@ class _BucketPrograms:
         )
         self._chunks: Dict[Tuple, Any] = {}
 
+    @property
+    def threshold_method(self) -> str:
+        """Provenance label for the thresholds ``run_error_scalers``
+        produces — derived from the SAME predicate that selects the
+        algorithm below, so the recorded metadata can never drift from
+        what actually ran."""
+        if self.seq is None or self.threshold_quantile >= 1.0:
+            return "exact"
+        return f"histogram-{_QUANTILE_BINS}"
+
     def run_error_scalers(self, params, X, mask):
         """``fit_error_scalers``, chunked over members for the sequence
         ``q < 1`` histogram pass: its (f+1)*8192-cell per-member scan
@@ -605,6 +615,11 @@ class FleetMemberModel:
     kl_weight: float = 1.0
     threshold_quantile: float = 1.0
     require_thresholds: bool = False
+    # threshold provenance: "exact" (max / jnp.nanquantile over the full
+    # error set) or "histogram-8192" (sequence families with q < 1: the
+    # streaming pass bounds the error by range/8192 instead of matching
+    # the single build bit-for-bit) — surfaced via detector metadata
+    threshold_method: str = "exact"
 
     def _module(self):
         factory = lookup_factory(self.model_type, self.kind)
@@ -688,6 +703,7 @@ class FleetMemberModel:
         if self.feature_thresholds is not None:
             det.feature_thresholds_ = np.asarray(self.feature_thresholds)
             det.total_threshold_ = float(self.total_threshold)
+            det.threshold_method_ = self.threshold_method
         return det
 
 
@@ -1394,6 +1410,7 @@ class FleetTrainer:
                 kl_weight=self.kl_weight,
                 threshold_quantile=self.threshold_quantile,
                 require_thresholds=self.require_thresholds,
+                threshold_method=progs.threshold_method,
             )
         # clear only once results are unstacked on host: a preemption during
         # the error-scaler pass / unstacking above can still resume from the

@@ -2,6 +2,8 @@
 and the thread/process engines must produce identical datasets, and the
 worker floor must engage concurrency even on single-core builders."""
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -108,6 +110,62 @@ class TestEngines:
         proc = stage_members(configs, workers=2, mode="process")
         for (xs, _), (xp, _) in zip(sync, proc):
             pd.testing.assert_frame_equal(xs, xp)
+
+    @pytest.mark.skipif(
+        (os.cpu_count() or 1) < 2,
+        reason="single-core host: spawned workers would only time-slice, "
+        "so a speedup assertion would measure scheduler noise "
+        "(VERDICT r4 next #7 keeps this armed for any multi-core "
+        "CI/bench host)",
+    )
+    def test_process_pool_beats_sync_on_multicore(self):
+        """On a multi-core host, process-mode staging of CPU-bound
+        providers must beat the sync loop at >=2 workers — the scaling
+        evidence the north-star build path's throughput claim rests on.
+        The measured sweep itself lives in bench.py
+        (host_staging_worker_sweep); this asserts the direction.
+
+        The workload is CALIBRATED on the running host: one warm member is
+        timed, then enough members are staged that the sync leg takes
+        ~20s — so the ~3s/worker spawn+import cost (which amortizes away
+        at real fleet widths of hundreds of members) stays a small
+        fraction, on fast and slow hosts alike. A fixed member count
+        would either fail on fast hosts (spawn dominates) or waste
+        minutes on slow ones."""
+        import time
+
+        def big_configs(n, days=180, tags=24):
+            end = (
+                pd.Timestamp("2020-01-01") + pd.Timedelta(days=days)
+            ).isoformat()
+            return [
+                {
+                    "type": "RandomDataset",
+                    "train_start_date": "2020-01-01",
+                    "train_end_date": end,
+                    "tag_list": [f"big-{i}-{j}" for j in range(tags)],
+                }
+                for i in range(n)
+            ]
+
+        stage_members(big_configs(1), workers=1)  # warm the import path
+        t0 = time.time()
+        stage_members(big_configs(1), workers=1)
+        per_member = max(time.time() - t0, 1e-3)
+        n = int(min(max(8, 20.0 / per_member), 256))
+        configs = big_configs(n)
+        t0 = time.time()
+        sync = stage_members(configs, workers=1)
+        sync_s = time.time() - t0
+        t0 = time.time()
+        proc = stage_members(configs, workers=2, mode="process")
+        proc_s = time.time() - t0
+        for (xs, _), (xp, _) in zip(sync[:3], proc[:3]):
+            pd.testing.assert_frame_equal(xs, xp)
+        assert proc_s < sync_s, (
+            f"process staging ({proc_s:.1f}s @ 2 workers, {n} members) did "
+            f"not beat sync ({sync_s:.1f}s) on a {os.cpu_count()}-core host"
+        )
 
     def test_non_picklable_configs_fall_back_to_threads(self):
         from gordo_components_tpu.dataset.data_provider.providers import (
