@@ -18,7 +18,7 @@ survey time, so citations are of the form ``gordo_components/<path>
 (unverified)``).
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 MAJOR_VERSION = 0
-MINOR_VERSION = 2
+MINOR_VERSION = 3

@@ -191,11 +191,16 @@ def checkpoint_prune_cmd(checkpoint_dir, older_than_days):
 @click.option("--model-dir", envvar="MODEL_COLLECTION_DIR", required=True)
 @click.option("--host", default="0.0.0.0", envvar="SERVER_HOST")
 @click.option("--port", default=5555, envvar="SERVER_PORT", type=int)
-def run_server_cmd(model_dir, host, port):
+@click.option(
+    "--devices", default=None, type=int, envvar="GORDO_SERVER_DEVICES",
+    help="Shard the model bank over an N-device models-axis mesh "
+    "(0/unset = all available devices when more than one is present).",
+)
+def run_server_cmd(model_dir, host, port, devices):
     """Serve the model collection under MODEL_COLLECTION_DIR."""
     from gordo_components_tpu.server import run_server
 
-    run_server(model_dir, host=host, port=port)
+    run_server(model_dir, host=host, port=port, devices=devices)
 
 
 @gordo.command("run-watchman")

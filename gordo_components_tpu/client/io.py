@@ -91,6 +91,7 @@ async def fetch_json(
                         resp.history,
                         status=resp.status,
                         message=await resp.text(),
+                        headers=resp.headers,  # carries Retry-After on 429
                     )
                 if resp.status >= 400:
                     body = await resp.text()
@@ -98,7 +99,27 @@ async def fetch_json(
                 return await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             last_exc = exc
+            if attempt + 1 >= retries:
+                break  # no retry left: sleeping first would only delay the error
             delay = backoff * (2**attempt)
+            # a shedding server's Retry-After is its queue-drain estimate
+            # (server/bank.py EngineOverloaded): honoring it beats blind
+            # exponential backoff — the fleet-backfill storm re-offers
+            # load right when capacity frees instead of too early (more
+            # sheds) or too late (idle server). Clamped: the value is
+            # server-controlled, and float('inf')/huge values must not
+            # hang the backfill
+            if (
+                isinstance(exc, aiohttp.ClientResponseError)
+                and exc.headers is not None
+                and exc.headers.get("Retry-After")
+            ):
+                try:
+                    delay = max(
+                        delay, min(float(exc.headers["Retry-After"]), 60.0)
+                    )
+                except ValueError:
+                    pass  # HTTP-date form: keep the computed backoff
             logger.warning(
                 "Request %s %s failed (%s); retry %d/%d in %.1fs",
                 method, url, exc, attempt + 1, retries, delay,

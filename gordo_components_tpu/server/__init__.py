@@ -192,10 +192,11 @@ def run_server(
     host: str = "0.0.0.0",
     port: int = 5555,
     target_name: Optional[str] = None,
+    devices: Optional[int] = None,
 ) -> None:
     """Blocking server entrypoint (reference: ``run_server`` /
     ``Dockerfile-ModelServer`` CMD)."""
-    app = build_app(model_dir, target_name=target_name)
+    app = build_app(model_dir, target_name=target_name, devices=devices)
     logger.info(
         "Serving %d model(s) on %s:%d", len(app["collection"].models), host, port
     )

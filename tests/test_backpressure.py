@@ -88,6 +88,41 @@ async def test_engine_default_bound_is_generous(one_model):
     assert engine.stats["shed"] == 0
 
 
+async def test_client_honors_retry_after_on_429():
+    """The bulk client's transport sleeps at least the server's
+    Retry-After drain estimate before re-offering load (instead of its
+    blind exponential backoff), then succeeds."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu.client.io import fetch_json
+
+    calls = {"n": 0, "times": []}
+
+    async def handler(request):
+        calls["n"] += 1
+        calls["times"].append(time.monotonic())
+        if calls["n"] == 1:
+            return web.json_response(
+                {"error": "queue full"}, status=429, headers={"Retry-After": "1"}
+            )
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/score", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        url = f"http://{client.host}:{client.port}/score"
+        body = await fetch_json(client.session, url, backoff=0.01)
+    finally:
+        await client.close()
+    assert body == {"ok": True}
+    assert calls["n"] == 2
+    # the gap obeys the header (1s), not the 0.01s configured backoff
+    assert calls["times"][1] - calls["times"][0] >= 0.95
+
+
 async def test_http_429_with_retry_after(tmp_path, one_model):
     det, X = one_model
     serializer.dump(det, str(tmp_path / "m"), metadata={"name": "m"})
