@@ -825,6 +825,23 @@ def bench_north_star_serving(n_members=10000, epochs=2, concurrency=64):
         "north_star_peak_rss_mb": res["peak_rss_mb"],
         "north_star_digest_gzip_mb": res["control_plane"]["digest_gzip_mb"],
         "north_star_device_memory": res.get("device_memory") or None,
+        # round-5 legs: bounded-queue overload behavior and the
+        # fleet-scale bulk-client backfill through a live server
+        "north_star_overload": {
+            k: res["overload"][k]
+            for k in ("offered_rps", "served_rps", "shed_rate",
+                      "served_p50_ms", "served_p99_ms")
+        },
+        "north_star_overload_compliant": {
+            k: res["overload_compliant"][k]
+            for k in ("offered_rps", "served_rps", "shed_rate",
+                      "served_p50_ms", "served_p99_ms")
+        },
+        "north_star_client_backfill": {
+            k: res["client_backfill"][k]
+            for k in ("machines", "machines_ok", "rows_per_sec", "parquet",
+                      "wall_s")
+        },
     }
 
 

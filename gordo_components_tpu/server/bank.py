@@ -510,6 +510,10 @@ class ModelBank:
             "banked": len(self._index),
             "fallback": dict(self.fallback),
             "n_buckets": len(self._buckets),
+            # how many chips the stacked state is sharded over (1 =
+            # single-device bank) — lets an operator confirm an 8-chip
+            # server is actually using its slice from /models alone
+            "devices": int(self.mesh.devices.size) if self.mesh is not None else 1,
         }
 
     def warmup(self, rows: int = 256) -> int:

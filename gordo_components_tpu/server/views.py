@@ -93,6 +93,7 @@ def _bank_coverage(request: web.Request, names) -> Any:
             if n not in bank
         },
         "n_buckets": cov["n_buckets"],
+        "devices": cov["devices"],
     }
 
 

@@ -157,10 +157,60 @@ async def test_build_app_devices_serves_sharded(tmp_path, many_models):
             assert resp.status == 200
             frames.append(await resp.json())
             mresp = await client.get("/gordo/v0/proj/models")
-            assert set((await mresp.json())["bank"]["banked"]) == {"m-00", "m-07"}
+            mbody = await mresp.json()
+            assert set(mbody["bank"]["banked"]) == {"m-00", "m-07"}
+            assert mbody["bank"]["devices"] == devices
         finally:
             await client.close()
     assert frames[0] == frames[1]
+
+
+async def test_reload_rebuilds_under_same_mesh(tmp_path, many_models):
+    """POST /reload must rebuild the bank under the app's original mesh —
+    a reload on an 8-chip server that silently fell back to one device
+    would strand 7 chips until the next restart."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.server import build_app
+
+    models, X = many_models
+    serializer.dump(models["m-01"], str(tmp_path / "m-01"), metadata={"name": "m-01"})
+    client = TestClient(TestServer(build_app(str(tmp_path), devices=8)))
+    await client.start_server()
+    try:
+        app = client.app
+        assert app["bank"].mesh is not None
+        # a new artifact appears on disk; reload picks it up
+        serializer.dump(
+            models["m-02"], str(tmp_path / "m-02"), metadata={"name": "m-02"}
+        )
+        resp = await client.post("/gordo/v0/p/reload")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["bank_models"] == 2
+        assert app["bank"].mesh is not None  # still sharded
+        assert app["bank"].mesh.devices.size == 8
+        resp = await client.post(
+            "/gordo/v0/p/m-02/anomaly/prediction", json={"X": X[:20].tolist()}
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+def test_devices_beyond_available_clamp(tmp_path, many_models):
+    """devices > jax.device_count() warns and clamps instead of crashing
+    (a manifest requesting 8 chips must still boot on a smaller slice)."""
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.server import build_app
+
+    models, _ = many_models
+    serializer.dump(models["m-03"], str(tmp_path / "m-03"), metadata={"name": "m-03"})
+    app = build_app(str(tmp_path), devices=999)
+    bank = app["bank"]
+    assert bank.mesh is not None
+    assert bank.mesh.devices.size == jax.device_count()
 
 
 async def test_batching_engine_over_sharded_bank(many_models):

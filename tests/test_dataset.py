@@ -121,6 +121,52 @@ class TestTimeSeriesDataset:
         assert X.shape == (72, 3)
         assert y is None
 
+    def test_nan_rows_dropped_exactly_like_pandas_dropna(self):
+        """The numpy fast path that replaced df.dropna() (staging hot
+        loop, ~25% of per-member cost) must drop exactly the rows pandas
+        would: ragged tag coverage leaves NaNs at the join edges."""
+
+        class RaggedProvider(RandomDataProvider):
+            def load_series(self, from_ts, to_ts, tag_list, dry_run=False):
+                for i, tag in enumerate(tag_list):
+                    # each tag starts one resample-bucket later
+                    yield pd.Series(
+                        np.arange(144.0, dtype="float32"),
+                        index=pd.date_range(
+                            from_ts + pd.Timedelta(minutes=10 * i),
+                            periods=144, freq="5min", tz="UTC",
+                        ),
+                        name=tag.name,
+                    )
+
+        ds = TimeSeriesDataset(
+            train_start_date="2020-01-01T00:00:00Z",
+            train_end_date="2020-01-01T12:00:00Z",
+            tag_list=["a", "b", "c"],
+            data_provider=RaggedProvider(),
+            resolution="10min",
+        )
+        X, _ = ds.get_data()
+        md = ds.get_metadata()
+        assert md["rows_joined"] > md["rows_after_dropna"]  # NaNs existed
+        assert len(X) == md["rows_after_dropna"]
+        assert not X.isna().any().any()
+        # row-for-row identical to the pandas semantics it replaced
+        from gordo_components_tpu.dataset.datasets import join_timeseries
+
+        series = list(
+            RaggedProvider().load_series(
+                ds.train_start_date, ds.train_end_date,
+                ds.tag_list,
+            )
+        )
+        df, _meta = join_timeseries(
+            series, ds.train_start_date, ds.train_end_date, "10min"
+        )
+        pd.testing.assert_frame_equal(
+            X, df.dropna()[[t.name for t in ds.tag_list]]
+        )
+
     def test_target_tags(self):
         ds = TimeSeriesDataset(
             train_start_date="2020-01-01T00:00:00Z",
