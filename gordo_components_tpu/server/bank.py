@@ -655,7 +655,9 @@ class ModelBank:
                         idx[d, j] = self._index[requests[ri][0]][1] - d * shard
                         slots[ci] = (d, j)
                 out = bucket.score_batch_sharded(idx, Xb, Yb)
-            recon, diff, scaled, tot_u, tot_s = (np.asarray(a) for a in out)
+            # one transfer for all five outputs (device_get batches the
+            # D2H copies) instead of five blocking np.asarray round-trips
+            recon, diff, scaled, tot_u, tot_s = jax.device_get(out)
             # reassemble per-request: each chunk contributes its VALID
             # output rows (rows computed from real, unpadded input)
             per_req: Dict[int, List[int]] = {}
@@ -666,9 +668,22 @@ class ModelBank:
             for ri, cis in per_req.items():
                 name, X, _yv = requests[ri]
                 n_out = X.shape[0] - off
-                cat = lambda arr: np.concatenate(
-                    [arr[slots[ci]][: valid[ci]] for ci in cis], axis=0
-                )[:n_out]
+                if len(cis) == 1:
+                    # single-chunk request (the serving-path norm): one
+                    # sliced copy instead of a concatenate per output
+                    # array — the concatenate machinery (list build +
+                    # dtype resolve) was the top host cost in the
+                    # coalesced hot loop (profiled round 5). The copy is
+                    # deliberate: a view would pin the whole (B, T, ...)
+                    # batch output alive as long as any one result is
+                    # held, and would be read-only where the multi-chunk
+                    # path returns writable arrays
+                    s0 = slots[cis[0]]
+                    cat = lambda arr: arr[s0][:n_out].copy()
+                else:
+                    cat = lambda arr: np.concatenate(
+                        [arr[slots[ci]][: valid[ci]] for ci in cis], axis=0
+                    )[:n_out]
                 results[ri] = ScoreResult(
                     tags=self._tags[name],
                     model_input=np.asarray(X, np.float32),
@@ -822,6 +837,15 @@ class BatchingEngine:
             batch.append(first)
             deadline = time.monotonic() + self.flush_s
             while len(batch) < self.max_batch:
+                # drain whatever is already queued without arming a timer
+                # per item — wait_for's per-call timer handle was real
+                # heap churn in the coalesced hot loop (profiled round 5)
+                try:
+                    while len(batch) < self.max_batch:
+                        batch.append(self._queue.get_nowait())
+                    break
+                except asyncio.QueueEmpty:
+                    pass
                 timeout = deadline - time.monotonic()
                 if timeout <= 0:
                     break
