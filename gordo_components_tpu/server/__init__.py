@@ -86,17 +86,29 @@ def build_app(
     once and routes requests to the owning chip — the layout the
     generated manifests' ``server_devices`` request assumes.
     """
+    def env_int(
+        name: str, default: Optional[str] = None, hint: str = ""
+    ) -> Optional[int]:
+        """Integer env knob with an actionable error: these deploy to
+        every replica, and a bare int() traceback would crashloop the
+        fleet with no hint which knob is malformed."""
+        raw = os.environ.get(name, default)
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{name} must be an integer, got {raw!r}"
+                + (f" ({hint})" if hint else "")
+            ) from None
+
     if use_bank is None:
         use_bank = os.environ.get("GORDO_SERVER_BANK", "1") != "0"
     if devices is None:
-        raw = os.environ.get("GORDO_SERVER_DEVICES", "0")
-        try:
-            devices = int(raw)
-        except ValueError:
-            raise ValueError(
-                f"GORDO_SERVER_DEVICES must be an integer, got {raw!r} "
-                "(0/unset = all available devices)"
-            ) from None
+        devices = env_int(
+            "GORDO_SERVER_DEVICES", "0", hint="0/unset = all available devices"
+        )
     mesh = None
     if use_bank and devices != 1:
         import jax
@@ -128,13 +140,7 @@ def build_app(
     if bank_max_queue is None and os.environ.get("GORDO_BANK_MAX_QUEUE"):
         # operator backpressure knob: how deep the scoring queue may grow
         # before requests shed with 429 (default 8 * max_batch)
-        raw = os.environ["GORDO_BANK_MAX_QUEUE"]
-        try:
-            bank_max_queue = int(raw)
-        except ValueError:
-            raise ValueError(
-                f"GORDO_BANK_MAX_QUEUE must be an integer, got {raw!r}"
-            ) from None
+        bank_max_queue = env_int("GORDO_BANK_MAX_QUEUE")
     app["bank_config"] = {
         "max_batch": bank_max_batch,
         "flush_ms": bank_flush_ms,

@@ -228,6 +228,7 @@ class _Bucket:
         self._score = None
         self.n_shards = 1  # mesh model-axis size after finalize()
         self.shard_size = 0  # models per shard (padded stack / n_shards)
+        self._sharding = None  # NamedSharding on the model axis (mesh mode)
 
     @property
     def offset(self) -> int:
@@ -255,7 +256,7 @@ class _Bucket:
             n_pad = pad_count_to_mesh(len(entries), self.mesh)
             entries = entries + [entries[-1]] * (n_pad - len(entries))
             self.shard_size = n_pad // self.n_shards
-            sharding = shard_model_axis(self.mesh)
+            sharding = self._sharding = shard_model_axis(self.mesh)
         stacked = jax.tree.map(
             lambda *leaves: np.stack(leaves), *[e.params for e in entries]
         )
@@ -349,9 +350,7 @@ class _Bucket:
         """Mesh path. indices: (D, Blocal) LOCAL indices (into each
         device's shard), X/Y: (D, Blocal, T, F), routed by the caller so
         row d only references models owned by shard d."""
-        from gordo_components_tpu.parallel.mesh import shard_model_axis
-
-        sh = shard_model_axis(self.mesh)
+        sh = self._sharding  # built once in finalize()
         return self._score(
             self.params,
             *self.scalers,
@@ -474,20 +473,14 @@ class ModelBank:
         for bucket in bank._buckets.values():
             bucket.finalize()
         if bank._index:
-            if bank.mesh is not None:
-                logger.info(
-                    "Model bank: %d models in %d bucket(s), sharded over "
-                    "%d device(s)",
-                    len(bank._index),
-                    len(bank._buckets),
-                    bank.mesh.devices.size,
-                )
-            else:
-                logger.info(
-                    "Model bank: %d models in %d bucket(s)",
-                    len(bank._index),
-                    len(bank._buckets),
-                )
+            logger.info(
+                "Model bank: %d models in %d bucket(s)%s",
+                len(bank._index),
+                len(bank._buckets),
+                ""
+                if bank.mesh is None
+                else f", sharded over {bank.mesh.devices.size} device(s)",
+            )
         # coverage is an operator signal: at 10k models a DEBUG line per
         # fallback is invisible — surface the aggregate loudly (and per
         # model through /models; see views.list_models)

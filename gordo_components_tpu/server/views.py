@@ -15,8 +15,10 @@ stays responsive while XLA executes.
 """
 
 import asyncio
+import functools
 import json
 import logging
+import math
 import time
 from typing import Any
 
@@ -66,8 +68,6 @@ def _bank_engine(request: web.Request):
 
 def _http_overloaded(exc: EngineOverloaded) -> web.HTTPTooManyRequests:
     """429 with a drain-estimate Retry-After for a shed request."""
-    import math
-
     return web.HTTPTooManyRequests(
         text=json.dumps(
             {"error": str(exc), "retry_after_s": round(exc.retry_after_s, 2)}
@@ -245,8 +245,6 @@ async def reload_models(request: web.Request) -> web.Response:
         bank_models = None
         if app.get("bank_enabled"):
             from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
-
-            import functools
 
             bank = await loop.run_in_executor(
                 None,
