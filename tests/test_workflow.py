@@ -118,6 +118,26 @@ class TestGenerator:
             container = job["spec"]["template"]["spec"]["containers"][0]
             assert container["resources"]["requests"]["google.com/tpu"] == "8"
 
+    def test_server_deployment_shards_bank_over_requested_chips(self):
+        """The server Deployment's TPU resource request and its
+        GORDO_SERVER_DEVICES env must agree — the env is what actually
+        shards the bank (server/__init__.py), so a manifest requesting 8
+        chips without it would idle 7 of them."""
+        config = NormalizedConfig(CONFIG_YAML)
+        docs = [d for d in yaml.safe_load_all(generate_workflow(config, "p")) if d]
+        server = next(
+            d for d in docs
+            if d["kind"] == "Deployment" and "server" in d["metadata"]["name"]
+        )
+        container = server["spec"]["template"]["spec"]["containers"][0]
+        requested = container["resources"]["requests"]["google.com/tpu"]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["GORDO_SERVER_DEVICES"] == str(requested) == "8"
+        # malformed server_devices fails at generation, not as a
+        # fleet-wide crashloop at pod start
+        with pytest.raises(ValueError, match="server_devices"):
+            generate_workflow(config, "p", server_devices="all")
+
     def test_machines_embedded_in_configmaps(self):
         config = NormalizedConfig(CONFIG_YAML)
         docs = [d for d in yaml.safe_load_all(generate_workflow(config, "p")) if d]
