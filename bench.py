@@ -595,7 +595,12 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
     concurrency = min(n_models, 32)
 
     async def _drive(n_iters):
-        engine = BatchingEngine(bank, max_batch=concurrency, flush_ms=2.0)
+        # registry=False: the warm and measured rounds each build a fresh
+        # engine, and shared registry histograms would blend them — the
+        # reported queue-wait snapshot must cover the measured round only
+        engine = BatchingEngine(
+            bank, max_batch=concurrency, flush_ms=2.0, registry=False
+        )
         engine.start()
         lat: list = []
 
@@ -1142,6 +1147,24 @@ def run_metrics_child(
                 # mistaken for full-size runs
                 out[f"{name}_scaled_config"] = kwargs
             print(f"METRIC {name} " + json.dumps(out), flush=True)
+    # snapshot the process metrics registry (observability/) into the
+    # detail document: every fleet-train/bank-serve metric above recorded
+    # per-bucket compile counts, per-shard routed/padded rows, engine
+    # coalescing histograms etc. there, and BENCH_DETAIL.json is where the
+    # record survives. Best-effort: a snapshot failure must not cost the
+    # run its measured numbers.
+    try:
+        from gordo_components_tpu.observability import get_registry
+
+        snap = get_registry().snapshot()
+        if snap:
+            print(
+                "METRIC observability_registry "
+                + json.dumps({"observability_registry": snap}, default=str),
+                flush=True,
+            )
+    except Exception:
+        pass
 
 
 def run_metrics_supervised(

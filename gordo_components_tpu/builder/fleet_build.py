@@ -32,11 +32,29 @@ from gordo_components_tpu.parallel.fleet import (
     _family_defaults,
     _target_offset_for,
 )
+from gordo_components_tpu.observability import get_registry
 from gordo_components_tpu.utils import metadata_timestamp
 from gordo_components_tpu.utils.staging import stage_members
 from gordo_components_tpu.workflow.config import Machine
 
 logger = logging.getLogger(__name__)
+
+
+def _build_counters():
+    """Builder-process metrics (observability/): how many models were
+    built, how, and how many the cache spared — progress a restarted gang
+    pod's registry snapshot makes visible next to its heartbeats."""
+    reg = get_registry()
+    return {
+        "built": reg.counter(
+            "gordo_build_models_built_total",
+            "Models built (artifact written)", ("path",),
+        ),
+        "cache_hits": reg.counter(
+            "gordo_build_cache_hits_total",
+            "Builds skipped because the register cache satisfied them",
+        ),
+    }
 
 _AE_PATHS = (
     "gordo_components_tpu.models.AutoEncoder",
@@ -350,6 +368,7 @@ def build_fleet(
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
     trainer_mesh = None
     dist_ok = False
+    counters = _build_counters()  # once: the families are process-wide
 
     if distributed:
         # pod-scale gang: every host runs this same function; each owns a
@@ -447,6 +466,7 @@ def build_fleet(
                     replace_cache=replace_cache,
                     evaluation_config=machine.evaluation or None,
                 )
+                counters["built"].labels("single").inc()
                 if heartbeat is not None:
                     heartbeat.update(phase="building", built=len(results))
             else:
@@ -458,7 +478,7 @@ def build_fleet(
             _build_fleet_group(
                 group, output_dir, model_register_dir, replace_cache, results,
                 checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-                mesh=trainer_mesh, heartbeat=heartbeat,
+                mesh=trainer_mesh, heartbeat=heartbeat, counters=counters,
             )
     except BaseException as exc:
         if heartbeat is not None:
@@ -481,8 +501,11 @@ def _build_fleet_group(
     checkpoint_every: int = 1,
     mesh=None,
     heartbeat=None,
+    counters=None,
 ) -> None:
     ae_kwargs = copy.deepcopy(group[0][1])
+    if counters is None:  # direct callers (tests) outside build_fleet
+        counters = _build_counters()
 
     # cache check per machine first — reruns skip already-built members
     # (a CV-requesting machine only hits if the artifact records matching
@@ -501,6 +524,7 @@ def _build_fleet_group(
                 logger.info("Machine %s: cache hit", machine.name)
                 _mirror_artifact(cached, os.path.join(output_dir, machine.name))
                 results[machine.name] = cached
+                counters["cache_hits"].inc()
                 continue
         pending.append(machine)
         pending_kwargs[machine.name] = kw
@@ -556,6 +580,7 @@ def _build_fleet_group(
             replace_cache=replace_cache,
             evaluation_config=machine.evaluation or None,
         )
+        counters["built"].labels("single").inc()
     if not pending:
         return
 
@@ -636,6 +661,7 @@ def _build_fleet_group(
         if os.path.abspath(mirror) != os.path.abspath(dest):
             serializer.dump(det, mirror, metadata=metadata)
         results[name] = dest
+        counters["built"].labels("fleet").inc()
         logger.info("Machine %s: fleet-built -> %s", name, dest)
     if heartbeat is not None:
         heartbeat.update(phase="building", built=len(results))

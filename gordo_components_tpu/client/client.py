@@ -9,7 +9,9 @@ prediction store.
 """
 
 import asyncio
+import itertools
 import logging
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -81,6 +83,15 @@ class Client:
         self.use_parquet = use_parquet
         self._parquet_active = False
         self._metadata_all: Dict[str, Any] = {}
+        # request-id propagation: every scoring POST carries a unique
+        # X-Gordo-Request-Id the server threads through its access log and
+        # engine queue, so a slow/failed chunk in a fleet backfill is
+        # traceable end to end (client log line <-> server histogram entry)
+        self._rid_prefix = uuid.uuid4().hex[:12]
+        self._rid_seq = itertools.count(1)
+
+    def _next_request_id(self) -> str:
+        return f"{self._rid_prefix}-{next(self._rid_seq):x}"
 
     # ------------------------------------------------------------------ #
 
@@ -201,6 +212,7 @@ class Client:
     async def _post_parquet(
         self, session, target, endpoint, chunk: pd.DataFrame,
         chunk_y: Optional[pd.DataFrame] = None,
+        request_id: Optional[str] = None,
     ):
         """POST one chunk as a parquet body (index rides inside the file,
         so timestamps round-trip without the JSON string lists). Target
@@ -215,12 +227,15 @@ class Client:
             frame = pd.concat([chunk, chunk_y.add_prefix("__y__")], axis=1)
         buf = io.BytesIO()
         frame.to_parquet(buf)
+        headers = {"Content-Type": "application/x-parquet"}
+        if request_id:
+            headers["X-Gordo-Request-Id"] = request_id
         return await fetch_json(
             session,
             self._url(target, endpoint),
             method="POST",
             data=buf.getvalue(),
-            headers={"Content-Type": "application/x-parquet"},
+            headers=headers,
         )
 
     async def _predict_single(
@@ -243,11 +258,15 @@ class Client:
 
         async def post_chunk(chunk: pd.DataFrame, chunk_y: Optional[pd.DataFrame]):
             async with sem:
+                # one id per chunk, reused across the parquet->JSON
+                # downgrade re-post: both attempts are the SAME request
+                rid = self._next_request_id()
                 parquet_exc = None
                 if self._parquet_active:
                     try:
                         return await self._post_parquet(
-                            session, target, endpoint, chunk, chunk_y
+                            session, target, endpoint, chunk, chunk_y,
+                            request_id=rid,
                         )
                     except ValueError as exc:
                         # 4xx on the parquet body. Ambiguous: the server
@@ -257,11 +276,11 @@ class Client:
                         # JSON re-post below disambiguates; forced mode
                         # never downgrades (documented contract).
                         if self.use_parquet is True:
-                            errors.append(f"chunk {chunk.index[0]}: {exc}")
+                            errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
                             return None
                         parquet_exc = exc
                     except Exception as exc:
-                        errors.append(f"chunk {chunk.index[0]}: {exc}")
+                        errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
                         return None
                 payload = {
                     "X": chunk.values.tolist(),
@@ -275,9 +294,10 @@ class Client:
                         self._url(target, endpoint),
                         method="POST",
                         json_payload=payload,
+                        headers={"X-Gordo-Request-Id": rid},
                     )
                 except Exception as exc:
-                    errors.append(f"chunk {chunk.index[0]}: {exc}")
+                    errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
                     return None
                 if parquet_exc is not None:
                     # JSON succeeded where parquet 4xx'd: an encoding

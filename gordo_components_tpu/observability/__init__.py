@@ -1,0 +1,25 @@
+"""Unified fleet metrics layer.
+
+One dependency-free registry abstraction shared by every long-running
+process (serving, fleet builder, watchman, bench): label-aware Counter /
+Gauge / log-binned Histogram primitives with Prometheus text-format
+exposition and a JSON snapshot view, so the human-readable ``/stats``
+endpoint and the ``/metrics`` scrape endpoint read the same underlying
+integers and can never drift.
+"""
+
+from gordo_components_tpu.observability.metrics import (
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus_text,
+    render_samples,
+)
+
+__all__ = [
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "parse_prometheus_text",
+    "render_samples",
+]

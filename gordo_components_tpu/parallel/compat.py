@@ -1,0 +1,32 @@
+"""JAX API compatibility shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (kwarg
+``check_rep``) to ``jax.shard_map`` (kwarg ``check_vma``) across JAX
+releases; the containers this stack deploys to pin different jaxlib
+versions (the bench/CI image currently ships 0.4.x, where only the
+experimental spelling exists). This wrapper keeps one call shape —
+keyword ``mesh``/``in_specs``/``out_specs`` plus the modern ``check_vma``
+name — working on both, so the sharded serving bank and the DP trainer
+don't silently lose their multi-chip paths on an older runtime.
+"""
+
+try:  # modern spelling (jax >= 0.6)
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # 0.4.x/0.5.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

@@ -31,9 +31,9 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from gordo_components_tpu.ops.losses import mse_loss
+from gordo_components_tpu.parallel.compat import shard_map
 
 DATA_AXIS = "data"
 

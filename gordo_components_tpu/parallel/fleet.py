@@ -35,6 +35,7 @@ import numpy as np
 
 from gordo_components_tpu.models import train_core
 from gordo_components_tpu.models.register import lookup_factory
+from gordo_components_tpu.observability import get_registry
 from gordo_components_tpu.ops.scaler import (
     ScalerParams,
     fit_minmax,
@@ -560,11 +561,22 @@ _PROGRAM_CACHE_MAX = 128
 _PROGRAM_BUILDS = 0
 
 
+def _count_program_build() -> None:
+    """One counted cache-miss program build (both the hashable and
+    unhashable-kwargs paths must report into the SAME family)."""
+    global _PROGRAM_BUILDS
+    _PROGRAM_BUILDS += 1
+    get_registry().counter(
+        "gordo_fleet_program_builds_total",
+        "Fleet bucket-program builds (cache misses; recompile storms "
+        "show here)",
+    ).inc()
+
+
 def _bucket_programs(
     module, opt_name: str, lr: float, batch_size: int, seq=None,
     loss: str = "mse", kl_weight: float = 1.0, threshold_quantile: float = 1.0,
 ) -> _BucketPrograms:
-    global _PROGRAM_BUILDS
     key = (
         module, opt_name, float(lr), int(batch_size), seq, loss,
         float(kl_weight), float(threshold_quantile),
@@ -572,7 +584,7 @@ def _bucket_programs(
     try:
         prog = _PROGRAM_CACHE.get(key)
     except TypeError:  # unhashable factory kwargs: build uncached
-        _PROGRAM_BUILDS += 1
+        _count_program_build()
         return _BucketPrograms(
             module, opt_name, lr, batch_size, seq, loss, kl_weight,
             threshold_quantile,
@@ -583,7 +595,7 @@ def _bucket_programs(
         # after a wholesale wipe
         while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
             _PROGRAM_CACHE.popitem(last=False)
-        _PROGRAM_BUILDS += 1
+        _count_program_build()
         prog = _PROGRAM_CACHE[key] = _BucketPrograms(
             module, opt_name, lr, batch_size, seq, loss, kl_weight,
             threshold_quantile,
@@ -851,6 +863,23 @@ class FleetTrainer:
         for one member would change the gang's program shape.
         """
         t0 = time.time()
+        # fleet-build progress, published to the process metrics registry
+        # (observability/): a gang builder has no HTTP surface, but bench
+        # snapshots the registry and watchman-adjacent tooling can read it
+        # from NORTH_STAR/BENCH artifacts — and the gauges cost one set()
+        # per bucket/epoch, nothing per step
+        reg = get_registry()
+        self._g_members_total = reg.gauge(
+            "gordo_fleet_members_total", "Members in the current fleet fit"
+        ).labels()
+        self._g_members_trained = reg.gauge(
+            "gordo_fleet_members_trained",
+            "Members whose bucket finished training in the current fit",
+        ).labels()
+        self._g_members_active = reg.gauge(
+            "gordo_fleet_members_active",
+            "Members still training (not early-stopped) in the current bucket",
+        ).labels()
         self._member_hparams = {}
         for name, hp in (member_hparams or {}).items():
             if name not in members:
@@ -900,6 +929,8 @@ class FleetTrainer:
 
         out: Dict[str, FleetMemberModel] = {}
         bucket_stats = []
+        self._g_members_total.set(len(members))
+        self._g_members_trained.set(0)
         for (n_features, padded_rows), names in sorted(buckets.items()):
             tb = time.time()
             self._active_ckpt = None
@@ -924,6 +955,28 @@ class FleetTrainer:
             finally:
                 self._active_ckpt = None
             out.update(res)
+            self._g_members_trained.set(len(out))
+            # per-bucket compile visibility: epoch 0 carries the XLA
+            # compile (bucket_stats records the same split); the gauge
+            # makes it scrapeable/snapshotable without parsing metadata
+            blabel = f"f{n_features}x{padded_rows}"
+            compile_s = 0.0
+            if epoch_seconds:
+                steady = min(epoch_seconds[1:]) if len(epoch_seconds) > 1 else 0.0
+                compile_s = max(0.0, epoch_seconds[0] - steady)
+            reg.counter(
+                "gordo_fleet_bucket_builds_total",
+                "Bucket training runs", ("bucket",),
+            ).labels(blabel).inc()
+            reg.counter(
+                "gordo_fleet_bucket_epochs_total",
+                "Epochs trained per bucket", ("bucket",),
+            ).labels(blabel).inc(len(epoch_seconds))
+            reg.gauge(
+                "gordo_fleet_bucket_compile_seconds",
+                "Estimated XLA compile seconds (epoch 0 minus steady state)",
+                ("bucket",),
+            ).labels(blabel).set(round(compile_s, 3))
             bucket_stats.append(
                 {
                     "n_features": n_features,
@@ -1241,6 +1294,7 @@ class FleetTrainer:
                         if use_val and has_val[i]:
                             histories_val[i].append(float(vrow[i]))
             last = first_epoch + len(losses_rows) - 1
+            self._g_members_active.set(int((active > 0).sum()))
             if self.epoch_callback is not None:
                 self.epoch_callback(
                     {

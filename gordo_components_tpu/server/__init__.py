@@ -8,6 +8,7 @@ surface, so Ambassador-style routing by ``{target}`` still works.
 """
 
 import asyncio
+import itertools
 import logging
 import os
 import time
@@ -15,6 +16,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from gordo_components_tpu.observability import MetricsRegistry
 from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 from gordo_components_tpu.server.model_io import ModelCollection
 from gordo_components_tpu.server.stats import LatencyHistogram
@@ -22,11 +24,19 @@ from gordo_components_tpu.server.views import routes
 
 logger = logging.getLogger(__name__)
 
+# server-generated request-id sequence (used when the client sent none);
+# process-wide so ids stay unique across app rebuilds in one process
+_RID_SEQ = itertools.count(1)
+
 
 @web.middleware
 async def _stats_middleware(request, handler):
     """Per-endpoint-kind request/error counters + service-time histograms
-    for ``GET .../stats``. Single event-loop thread: plain dict/int
+    for ``GET .../stats``, plus request-id propagation: the client's
+    ``X-Gordo-Request-Id`` header (or a server-generated id) is stashed on
+    the request, echoed on the response, and logged in the access line —
+    so a latency-histogram outlier or an engine-batch failure is traceable
+    back to one request. Single event-loop thread: plain dict/int
     mutation is safe. Counter keys come from the matched route TEMPLATE
     (a bounded set) — keying on raw paths would let a scanner probing
     random URLs grow the dict without bound."""
@@ -43,25 +53,107 @@ async def _stats_middleware(request, handler):
     hist = stats["latency"].get(kind)
     if hist is None:
         hist = stats["latency"][kind] = LatencyHistogram()
+    # bounded: a hostile header must not become an unbounded log/label blob
+    rid = request.headers.get("X-Gordo-Request-Id", "")[:128] or (
+        f"srv-{next(_RID_SEQ):x}"
+    )
+    request["request_id"] = rid
     t0 = time.monotonic()
+    status = 500  # a non-HTTP handler crash surfaces as a 500
+    counted = False
     try:
         resp = await handler(request)
+        status = resp.status
     except web.HTTPException as exc:
+        status = exc.status
+        exc.headers["X-Gordo-Request-Id"] = rid
         if exc.status >= 400:
             stats["errors"] += 1
         raise
     except Exception:
-        # a handler crash becomes a 500 upstream; the counter must see
-        # exactly the failures an operator most needs to
+        # a handler crash is a 500; the counter must see exactly the
+        # failures an operator most needs to — and the response we build
+        # here (instead of re-raising into aiohttp's default handler)
+        # still carries the request-id echo, so the one request a client
+        # most wants to trace is the one that stays traceable
         stats["errors"] += 1
-        raise
+        counted = True
+        logger.exception(
+            "unhandled error serving %s %s (rid=%s)",
+            request.method, request.path, rid,
+        )
+        resp = web.json_response(
+            {"error": "internal server error", "request_id": rid}, status=500
+        )
     finally:
         # errored requests count too: a timeout-then-500 pattern is
         # exactly what a tail-latency histogram exists to surface
-        hist.record(time.monotonic() - t0)
-    if resp.status >= 400:
+        elapsed = time.monotonic() - t0
+        hist.record(elapsed)
+        logger.debug(
+            "access rid=%s %s %s %d %.1fms",
+            rid, request.method, request.path, status, elapsed * 1e3,
+        )
+    resp.headers["X-Gordo-Request-Id"] = rid
+    if not counted and resp.status >= 400:
         stats["errors"] += 1
     return resp
+
+
+def _server_collector(app: web.Application):
+    """Read-through exposition of the middleware's stats dict: the scrape
+    endpoint reads the same integers /stats reports, so they cannot
+    drift."""
+
+    def collect():
+        stats = app["stats"]
+        yield (
+            "gordo_server_uptime_seconds", "gauge",
+            "Seconds since server start", {},
+            time.time() - stats["started_at"],
+        )
+        for kind, n in stats["requests"].items():
+            yield (
+                "gordo_server_requests_total", "counter",
+                "HTTP requests by endpoint kind", {"kind": kind}, n,
+            )
+        yield (
+            "gordo_server_errors_total", "counter",
+            "HTTP responses with status >= 400", {}, stats["errors"],
+        )
+        for kind, hist in stats["latency"].items():
+            yield (
+                "gordo_server_request_seconds", "histogram",
+                "Service time by endpoint kind", {"kind": kind}, hist,
+            )
+        collection = app.get("collection")
+        if collection is not None:
+            yield (
+                "gordo_server_models", "gauge",
+                "Models loaded in the collection", {},
+                len(collection.models),
+            )
+
+    return collect
+
+
+def _hbm_collector():
+    """Device HBM usage as gauges, read fresh per scrape — the same
+    numbers ``utils/profiling.device_memory_stats`` records into build
+    metadata, republished live so memory headroom is scrapeable."""
+    from gordo_components_tpu.utils.profiling import device_memory_stats
+
+    def collect():
+        for dev, st in device_memory_stats().items():
+            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+                if key in st:
+                    yield (
+                        f"gordo_device_hbm_{key}", "gauge",
+                        "Per-device HBM memory (bytes)", {"device": dev},
+                        st[key],
+                    )
+
+    return collect
 
 
 def build_app(
@@ -134,6 +226,16 @@ def build_app(
         "errors": 0,
         "latency": {},
     }
+    # per-app metrics registry (observability/): the bank router and the
+    # batching engine record per-shard/per-bucket series here; ``GET
+    # .../metrics`` renders it as Prometheus text and ``GET .../stats``
+    # embeds the same registry's JSON snapshot — one source, two views.
+    # Per-app (not process-global) so test suites building many apps in
+    # one process don't bleed series into each other.
+    registry = MetricsRegistry()
+    app["metrics"] = registry
+    registry.collector(_server_collector(app), key="server")
+    registry.collector(_hbm_collector(), key="hbm")
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
     app["bank_enabled"] = use_bank
@@ -148,7 +250,7 @@ def build_app(
     }
     app["bank_mesh"] = mesh  # reload (views.py) rebuilds under the same mesh
     if use_bank:
-        bank = ModelBank.from_models(collection.models, mesh=mesh)
+        bank = ModelBank.from_models(collection.models, mesh=mesh, registry=registry)
         # expose the bank even when nothing banked: /models reports the
         # coverage (banked vs per-model fallback, with reasons)
         app["bank"] = bank

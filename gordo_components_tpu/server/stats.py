@@ -7,11 +7,11 @@ fixed-bin latency histograms and ``GET .../stats`` reports percentiles,
 because at fleet scale the interesting number is the tail produced by
 the coalescing window, not the mean.
 
-Log-spaced fixed bins: O(1) record (two float ops + an int increment),
-O(bins) percentile read, zero allocation on the hot path, and a bounded
-memory footprint no matter how many requests pass through — the standard
-histogram trade (one-bin-width relative error, here ~26% per bin =
-10 bins/decade) that Prometheus/HDRHistogram users expect.
+The log-binned histogram itself now lives in
+``gordo_components_tpu.observability.metrics`` (generalized to arbitrary
+value ranges so batch sizes and row counts histogram too, and exposed in
+Prometheus text format through the metrics registry); this module keeps
+the serving-flavored name and its single-writer contract documentation.
 
 Single-writer contract: all ``record`` sites run on the aiohttp event
 loop thread (middleware + BatchingEngine loop), so plain int increments
@@ -19,71 +19,16 @@ are safe without locks. Snapshot readers (the /stats handler) run on the
 same loop.
 """
 
-import math
+from gordo_components_tpu.observability.metrics import Histogram
 
 __all__ = ["LatencyHistogram"]
 
-# 50us .. ~100s at 10 bins/decade; everything slower lands in overflow
-_LO_S = 5e-5
-_BINS_PER_DECADE = 10
-_N_BINS = int(math.ceil(math.log10(100.0 / _LO_S) * _BINS_PER_DECADE)) + 1
-_LOG_LO = math.log10(_LO_S)
 
+class LatencyHistogram(Histogram):
+    """Latency histogram over log-spaced bins with percentile reads.
 
-class LatencyHistogram:
-    """Latency histogram over log-spaced bins with percentile reads."""
+    50us .. ~100s at 10 bins/decade (everything slower lands in the
+    overflow bin, where the tracked exact max is the reported bound) —
+    the defaults the serving stack has always used."""
 
-    __slots__ = ("counts", "count", "sum", "max")
-
-    def __init__(self):
-        self.counts = [0] * (_N_BINS + 1)  # +1: overflow bin
-        self.count = 0
-        self.sum = 0.0
-        self.max = 0.0
-
-    def record(self, seconds: float) -> None:
-        if seconds < 0:  # clock weirdness must not corrupt the histogram
-            seconds = 0.0
-        if seconds <= _LO_S:
-            idx = 0
-        else:
-            idx = min(
-                _N_BINS,
-                1 + int((math.log10(seconds) - _LOG_LO) * _BINS_PER_DECADE),
-            )
-        self.counts[idx] += 1
-        self.count += 1
-        self.sum += seconds
-        if seconds > self.max:
-            self.max = seconds
-
-    def percentile(self, q: float) -> float:
-        """Upper edge of the bin containing the q-quantile observation, in
-        seconds (<= one bin width above the true value). 0.0 when empty."""
-        if self.count == 0:
-            return 0.0
-        rank = q * self.count
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= rank and c:
-                if i >= _N_BINS:
-                    return self.max  # overflow bin: max is exact
-                # clamp to the exact max: a bin's upper edge can exceed
-                # every value ever recorded into it
-                return min(self.max, 10 ** (_LOG_LO + i / _BINS_PER_DECADE))
-        return self.max
-
-    def snapshot(self) -> dict:
-        """Compact JSON-ready summary for ``/stats``."""
-        if self.count == 0:
-            return {"count": 0}
-        ms = 1e3
-        return {
-            "count": self.count,
-            "mean_ms": round(self.sum / self.count * ms, 3),
-            "p50_ms": round(self.percentile(0.50) * ms, 3),
-            "p95_ms": round(self.percentile(0.95) * ms, 3),
-            "p99_ms": round(self.percentile(0.99) * ms, 3),
-            "max_ms": round(self.max * ms, 3),
-        }
+    __slots__ = ()

@@ -128,6 +128,26 @@ async def readiness(request: web.Request) -> web.Response:
     return web.json_response(body, status=200 if n > 0 else 503)
 
 
+@routes.get("/gordo/v0/{project}/metrics")
+async def metrics_exposition(request: web.Request) -> web.Response:
+    """Prometheus text-format exposition of the app's metrics registry
+    (observability/): request counters/latency histograms, the batching
+    engine's queue state, the bank router's per-shard routed/padded-row
+    counters and per-bucket coalescing histograms, and live HBM gauges.
+    The generated manifests annotate pods with this path for scraping;
+    watchman scrapes it to build the fleet-wide rollup."""
+    registry = request.app.get("metrics")
+    if registry is None:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": "metrics registry not enabled"}),
+            content_type="application/json",
+        )
+    return web.Response(
+        body=registry.render().encode("utf-8"),
+        headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+    )
+
+
 @routes.get("/gordo/v0/{project}/stats")
 async def server_stats(request: web.Request) -> web.Response:
     """Serving-process observability (SURVEY.md §5 metrics): request
@@ -166,6 +186,12 @@ async def server_stats(request: web.Request) -> web.Response:
     bank = request.app.get("bank")
     if bank is not None:
         body["bank_models"] = len(bank)
+    registry = request.app.get("metrics")
+    if registry is not None:
+        # the registry's JSON view: the SAME cells /metrics renders (per-
+        # shard routed/padded counters, engine shed/queue-depth, ...), so
+        # the human-readable endpoint and the scrape endpoint cannot drift
+        body["metrics"] = registry.snapshot()
     return web.json_response(body)
 
 
@@ -252,6 +278,9 @@ async def reload_models(request: web.Request) -> web.Response:
                     ModelBank.from_models,
                     collection.models,
                     mesh=app.get("bank_mesh"),
+                    # same registry across reloads: the family children
+                    # persist, so routed/padded counters stay monotonic
+                    registry=app.get("metrics"),
                 ),
             )
             # the rebuilt bank's jit closures are cold: re-warm them here,
@@ -346,7 +375,9 @@ async def prediction(request: web.Request) -> web.Response:
     try:
         if engine is not None:
             result = await engine.score(
-                request.match_info["target"], X.values.astype("float32")
+                request.match_info["target"],
+                X.values.astype("float32"),
+                request_id=request.get("request_id"),
             )
             output = result.model_output
         else:
@@ -392,6 +423,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 request.match_info["target"],
                 X.values.astype("float32"),
                 None if y is None else y.values.astype("float32"),
+                request_id=request.get("request_id"),
             )
             frame = result.to_frame(index=X.index)
         else:

@@ -229,6 +229,14 @@ async def test_batching_engine_over_sharded_bank(many_models):
         await engine.stop()
     assert engine.stats["max_batch_seen"] > 1  # they really coalesced
     for n, r in zip(names, results):
-        np.testing.assert_array_equal(
-            r.total_scaled, single.score(n, X[:40]).total_scaled
+        # allclose, not array_equal: the engine coalesces these into one
+        # padded batch (B=16), and XLA fuses a B=16 program differently
+        # from the B=1 reference — ~1 ULP float32 reassociation on CPU.
+        # Bitwise sharded-vs-single parity at the SAME batch composition
+        # is asserted by test_sharded_heterogeneous_batch above.
+        np.testing.assert_allclose(
+            r.total_scaled,
+            single.score(n, X[:40]).total_scaled,
+            rtol=1e-5,
+            atol=1e-6,
         )

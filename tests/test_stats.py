@@ -50,3 +50,51 @@ def test_extremes_do_not_corrupt():
     snap = h.snapshot()
     assert snap["max_ms"] == 1e9
     assert snap["p50_ms"] >= 0
+
+
+def test_percentile_clamps_out_of_range_q():
+    """q outside [0, 1] must clamp, not walk off the rank math: q >= 1 is
+    the exact max, q <= 0 the first observation's bin."""
+    h = LatencyHistogram()
+    for v in (0.001, 0.01, 0.1):
+        h.record(v)
+    assert h.percentile(1.0) == h.percentile(2.5) == 0.1  # exact max
+    low = h.percentile(0.0)
+    assert low == h.percentile(-3.0)
+    # first observation's bin edge: within one bin width above 1ms, and
+    # never above the recorded max
+    assert 0.001 <= low <= 0.001 * 1.26
+    assert low <= h.max
+
+
+def test_percentile_single_observation():
+    """Every quantile of a single observation is that observation (to bin
+    precision; exact via the max clamp when it's the bin's largest)."""
+    h = LatencyHistogram()
+    h.record(0.004)
+    for q in (-1.0, 0.0, 0.5, 0.99, 1.0, 2.0):
+        assert h.percentile(q) == 0.004
+    # single observation in the overflow bin: max is exact for ALL q
+    ho = LatencyHistogram()
+    ho.record(5e4)
+    for q in (0.0, 0.5, 1.0):
+        assert ho.percentile(q) == 5e4
+
+
+def test_percentile_overflow_bin_edges():
+    """Overflow-bin behavior: low quantiles whose rank lands in real bins
+    must NOT jump to the overflow max; ranks landing in the overflow bin
+    report the exact max (the only honest bound the bin has)."""
+    h = LatencyHistogram()
+    for _ in range(99):
+        h.record(0.002)
+    h.record(7e5)  # overflow
+    assert h.percentile(0.5) <= 0.002 * 1.26  # median stays in its bin
+    assert h.percentile(0.99) <= 0.002 * 1.26  # rank 99 is still the low bin
+    assert h.percentile(0.995) == 7e5  # rank 100 -> overflow -> exact max
+    assert h.percentile(1.0) == 7e5
+    # all-overflow histogram: every rank can only report the max bound
+    ho = LatencyHistogram()
+    for v in (200.0, 500.0, 9e5):
+        ho.record(v)
+    assert ho.percentile(0.0) == ho.percentile(0.5) == ho.percentile(1.0) == 9e5

@@ -7,7 +7,13 @@ from aiohttp.test_utils import TestClient, TestServer
 
 from gordo_components_tpu import serializer
 from gordo_components_tpu.models import AutoEncoder
-from gordo_components_tpu.watchman.server import WatchmanState, build_watchman_app
+from gordo_components_tpu.observability import parse_prometheus_text
+from gordo_components_tpu.watchman.server import (
+    WatchmanState,
+    aggregate_fleet_metrics,
+    build_watchman_app,
+    render_fleet_metrics,
+)
 
 
 @pytest.fixture(scope="module")
@@ -122,6 +128,143 @@ async def test_watchman_healthcheck_endpoint():
         assert "gordo-watchman-version" in await resp.json()
     finally:
         await client.close()
+
+
+def test_aggregate_fleet_metrics_sums_max_and_skew():
+    """Rollup math: per-series sums/maxes across replicas, and the skew
+    ratio computed per replica (shards of different replicas are different
+    chips) with the fleet max reported."""
+    r1 = (
+        "# TYPE gordo_server_uptime_seconds gauge\n"
+        "gordo_server_uptime_seconds 900\n"
+        'gordo_bank_shard_routed_rows_total{shard="0"} 100\n'
+        'gordo_bank_shard_routed_rows_total{shard="1"} 300\n'
+        "gordo_engine_shed_total 2\n"
+        "gordo_engine_queue_depth NaN\n"  # dead closure: skipped, not poison
+    )
+    r2 = (
+        "# TYPE gordo_server_uptime_seconds gauge\n"
+        "gordo_server_uptime_seconds 60\n"
+        'gordo_bank_shard_routed_rows_total{shard="0"} 50\n'
+        'gordo_bank_shard_routed_rows_total{shard="1"} 50\n'
+        "gordo_engine_shed_total 5\n"
+    )
+    agg = aggregate_fleet_metrics([r1, r2])
+    assert agg["replicas_scraped"] == 2
+    assert agg["routed_rows_by_shard"] == {"0": 150.0, "1": 350.0}
+    # replica 1 skew = 300/200 = 1.5; replica 2 balanced -> fleet max 1.5
+    assert agg["shard_skew_ratio"] == 1.5
+    key = ("gordo_engine_shed_total", ())
+    assert agg["sums"][key] == 7.0
+    assert agg["maxs"][key] == 5.0
+    text = render_fleet_metrics(agg)
+    types, samples = parse_prometheus_text(text)
+    by_name = {n: v for n, l, v in samples if not l}
+    assert by_name["gordo_fleet_replicas_scraped"] == 2
+    assert by_name["gordo_fleet_shard_skew_ratio"] == 1.5
+    assert by_name["gordo_fleet_shard_routed_rows_max"] == 350
+    assert by_name["gordo_fleet_shard_routed_rows_mean"] == 250
+    # counters sum across replicas; gauges take the replica max (summing
+    # uptimes/limits across a fleet would report nonsense)
+    assert by_name["gordo_engine_shed_total"] == 7
+    assert by_name["gordo_server_uptime_seconds"] == 900
+    # the NaN sample was skipped entirely, not propagated
+    assert "gordo_engine_queue_depth" not in by_name
+    # first scrape has no baseline: skew computed over lifetime totals
+    assert agg["skew_window"] == "lifetime"
+    # next scrape WITH a baseline: skew over the delta window, so a newly
+    # hot shard shows even against a week of balanced history (and a
+    # rebalanced fleet's ratio clears)
+    r1b = (
+        'gordo_bank_shard_routed_rows_total{shard="0"} 110\n'  # +10
+        'gordo_bank_shard_routed_rows_total{shard="1"} 390\n'  # +90
+    )
+    agg2 = aggregate_fleet_metrics(
+        [r1b, r2], prev_shard_rows=agg["replica_shard_rows"]
+    )
+    assert agg2["skew_window"] == "delta"
+    # replica 1 delta skew = 90/50 = 1.8; replica 2 no traffic -> no signal
+    assert agg2["shard_skew_ratio"] == 1.8
+    # counter reset (replica restarted, totals fell BELOW the baseline):
+    # the void baseline must not produce negative-delta garbage ratios —
+    # the post-restart totals are the window
+    r1c = (
+        'gordo_bank_shard_routed_rows_total{shard="0"} 30\n'
+        'gordo_bank_shard_routed_rows_total{shard="1"} 10\n'
+    )
+    agg3 = aggregate_fleet_metrics(
+        [r1c], prev_shard_rows=agg["replica_shard_rows"][:1]
+    )
+    assert agg3["skew_window"] == "delta"
+    assert agg3["shard_skew_ratio"] == 1.5  # 30/20, not a negative-mean blowup
+
+
+async def test_watchman_fleet_metrics_rollup_live(collection_dir, live_server):
+    """Watchman scrapes the collection server's /metrics and serves the
+    fleet rollup on its own /metrics, plus a bounded summary in the root
+    snapshot."""
+    async with live_server(collection_dir) as base_url:
+        app = build_watchman_app("proj", base_url)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            types, samples = parse_prometheus_text(await resp.text())
+            by_name = {n: v for n, l, v in samples if not l}
+            assert by_name["gordo_fleet_replicas_scraped"] == 1
+            # the scraped server's own families ride along, summed
+            assert any(n == "gordo_server_uptime_seconds" for n, _, _ in samples)
+            body = await (await client.get("/")).json()
+            assert body["fleet-metrics"]["replicas_scraped"] == 1
+        finally:
+            await client.close()
+
+
+async def test_watchman_fleet_metrics_freezes_counters_on_scrape_miss():
+    """A transient scrape failure must not DROP the summed counters (a
+    dip-and-recover reads as a counter reset to Prometheus rate()): the
+    failed replica is frozen at its last successful body, while
+    replicas_scraped honestly reports the live count."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    calls = {"n": 0}
+
+    async def flaky_metrics(request):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise web.HTTPInternalServerError()
+        return web.Response(text="gordo_engine_shed_total 42\n")
+
+    app = web.Application()
+    app.router.add_get("/gordo/v0/proj/metrics", flaky_metrics)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        state = WatchmanState(
+            "proj", f"http://{server.host}:{server.port}", refresh_interval=0.0
+        )
+        first = await state.fleet_metrics()
+        assert first["replicas_scraped"] == 1
+        key = ("gordo_engine_shed_total", ())
+        assert first["sums"][key] == 42.0
+        second = await state.fleet_metrics()  # scrape now 500s
+        assert second["replicas_scraped"] == 0  # live count is honest
+        assert second["sums"][key] == 42.0  # frozen, not dropped
+    finally:
+        await server.close()
+
+
+async def test_watchman_fleet_metrics_degrades_without_servers():
+    """No reachable server: the rollup degrades to replicas_scraped=0 and
+    the snapshot omits fleet-metrics — never an error."""
+    state = WatchmanState("proj", "http://127.0.0.1:1", targets=["m-1"])
+    agg = await state.fleet_metrics()
+    assert agg["replicas_scraped"] == 0
+    assert agg["shard_skew_ratio"] is None
+    assert render_fleet_metrics(agg).startswith("# HELP gordo_fleet_replicas")
 
 
 def _counting_stub(n_targets, with_batched=True):
