@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -16,6 +16,12 @@ test-fast:
 	$(PYTHON) -m pytest tests/ -q -x \
 		--ignore=tests/test_fleet_chunks.py \
 		--ignore=tests/test_checkpoint.py
+
+# fault-injection lane: drive every registered faultpoint through the
+# public HTTP/build APIs and assert the documented degraded state
+# (tests/test_chaos.py; the standing regression harness for robustness)
+chaos:
+	$(PYTHON) -m pytest tests/ -q -m chaos
 
 bench:
 	$(PYTHON) bench.py

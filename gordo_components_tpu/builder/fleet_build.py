@@ -33,11 +33,42 @@ from gordo_components_tpu.parallel.fleet import (
     _target_offset_for,
 )
 from gordo_components_tpu.observability import get_registry
+from gordo_components_tpu.resilience.faults import faultpoint
 from gordo_components_tpu.utils import metadata_timestamp
 from gordo_components_tpu.utils.staging import stage_members
 from gordo_components_tpu.workflow.config import Machine
 
 logger = logging.getLogger(__name__)
+
+# chaos site (tests/test_chaos.py): one poisoned hparam group's training
+# must degrade to a partial manifest, never abort the whole gang
+_FP_GROUP = faultpoint("fleet_build.group")
+
+
+class FleetBuildReport(Dict[str, str]):
+    """``build_fleet``'s return value: name -> artifact dir, exactly the
+    mapping callers have always received, PLUS the partial-build record —
+    ``failed`` maps members whose group (or bespoke build) exhausted its
+    retries to the error string, and ``group_retries`` counts retry
+    attempts that eventually succeeded. ``manifest()`` renders the
+    partial-manifest schema the CLI ships."""
+
+    SCHEMA = "gordo.fleet-build.manifest/v1"
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__(*args, **kwargs)
+        self.failed: Dict[str, str] = {}
+        self.group_retries: int = 0
+
+    def manifest(self) -> Dict[str, Any]:
+        return {
+            "schema": self.SCHEMA,
+            "built": dict(self),
+            "failed": dict(self.failed),
+            "n_built": len(self),
+            "n_failed": len(self.failed),
+            "group_retries": self.group_retries,
+        }
 
 
 def _build_counters():
@@ -352,8 +383,11 @@ def build_fleet(
     distributed: bool = False,
     state_dir: Optional[str] = None,
     gang_id: Optional[str] = None,
-) -> Dict[str, str]:
-    """Build every machine; returns name -> artifact dir.
+    group_retries: Optional[int] = None,
+) -> "FleetBuildReport":
+    """Build every machine; returns a :class:`FleetBuildReport` —
+    name -> artifact dir (a plain dict to existing callers) with the
+    partial-build record on ``.failed``.
 
     Fleetable machines with identical AutoEncoder kwargs train together in
     one FleetTrainer program; everything else falls back to the single-model
@@ -363,8 +397,21 @@ def build_fleet(
     interrupted epoch loop instead of retraining from scratch.
     ``state_dir`` enables gang heartbeats (workflow/gang_state.py): phase
     and per-epoch progress on a shared volume for watchman to aggregate.
+
+    Failure isolation: a bespoke machine whose single build fails, or an
+    hparam group whose gang training fails ``group_retries + 1`` times
+    (default 1 retry; env ``GORDO_BUILD_GROUP_RETRIES``), records its
+    member(s) under ``.failed`` and every OTHER machine/group still
+    ships — one poisoned config must not abort a 10k-member gang. The
+    heartbeat ends in phase ``done`` (nothing failed), ``partial`` (some
+    members failed), or ``failed`` (nothing built).
     """
-    results: Dict[str, str] = {}
+    from gordo_components_tpu.resilience import configure_from_env
+
+    configure_from_env()  # GORDO_FAULTS: chaos runs drive the build path too
+    if group_retries is None:
+        group_retries = int(os.environ.get("GORDO_BUILD_GROUP_RETRIES", "1"))
+    results = FleetBuildReport()
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
     trainer_mesh = None
     dist_ok = False
@@ -456,38 +503,95 @@ def build_fleet(
                 logger.info(
                     "Machine %s: bespoke config, single-build path", machine.name
                 )
-                results[machine.name] = provide_saved_model(
-                    machine.name,
-                    machine.model,
-                    machine.dataset,
-                    machine.metadata,
-                    output_dir=os.path.join(output_dir, machine.name),
-                    model_register_dir=model_register_dir,
-                    replace_cache=replace_cache,
-                    evaluation_config=machine.evaluation or None,
-                )
-                counters["built"].labels("single").inc()
+                try:
+                    results[machine.name] = provide_saved_model(
+                        machine.name,
+                        machine.model,
+                        machine.dataset,
+                        machine.metadata,
+                        output_dir=os.path.join(output_dir, machine.name),
+                        model_register_dir=model_register_dir,
+                        replace_cache=replace_cache,
+                        evaluation_config=machine.evaluation or None,
+                    )
+                except Exception as exc:
+                    # per-machine isolation on the bespoke path: record and
+                    # keep building the rest of the gang
+                    results.failed[machine.name] = f"{type(exc).__name__}: {exc}"
+                    logger.error(
+                        "Machine %s: single build FAILED (%s); remaining "
+                        "machines continue", machine.name, exc, exc_info=True,
+                    )
+                else:
+                    counters["built"].labels("single").inc()
                 if heartbeat is not None:
-                    heartbeat.update(phase="building", built=len(results))
+                    heartbeat.update(
+                        phase="building", built=len(results),
+                        failed_members=len(results.failed),
+                    )
             else:
                 fleet_groups.setdefault(_group_key(ae_kwargs), []).append(
                     (machine, ae_kwargs)
                 )
 
         for _, group in fleet_groups.items():
-            _build_fleet_group(
-                group, output_dir, model_register_dir, replace_cache, results,
-                checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-                mesh=trainer_mesh, heartbeat=heartbeat, counters=counters,
-            )
+            # per-group isolation with bounded retry: a poisoned hparam
+            # group (bad LR diverging the whole stack, an injected fault,
+            # an OOM at this bucket's batch shape) exhausts its retries,
+            # records its members as failed, and the remaining groups
+            # still ship their artifacts
+            for attempt in range(group_retries + 1):
+                try:
+                    _build_fleet_group(
+                        group, output_dir, model_register_dir, replace_cache,
+                        results, checkpoint_dir=checkpoint_dir,
+                        checkpoint_every=checkpoint_every, mesh=trainer_mesh,
+                        heartbeat=heartbeat, counters=counters,
+                    )
+                    break
+                except Exception as exc:
+                    if attempt < group_retries:
+                        results.group_retries += 1
+                        logger.warning(
+                            "Fleet group of %d member(s) failed (attempt "
+                            "%d/%d): %s; retrying",
+                            len(group), attempt + 1, group_retries + 1, exc,
+                        )
+                        continue
+                    error = f"{type(exc).__name__}: {exc}"
+                    for m, _kw in group:
+                        # members already shipped (cache hits, a pre-crash
+                        # infeasible-CV single build) are built, not failed
+                        if m.name not in results:
+                            results.failed[m.name] = error
+                    logger.error(
+                        "Fleet group of %d member(s) FAILED after %d "
+                        "attempt(s); members recorded in the partial "
+                        "manifest; remaining groups continue: %s",
+                        len(group), group_retries + 1, error, exc_info=True,
+                    )
     except BaseException as exc:
+        # only non-build failures (preemption signals, a broken state
+        # volume, bugs outside the isolated paths) land here now
         if heartbeat is not None:
             heartbeat.finish(
                 "failed", built=len(results), error=f"{type(exc).__name__}: {exc}"
             )
         raise
     if heartbeat is not None:
-        heartbeat.finish("done", built=len(results))
+        if not results.failed:
+            heartbeat.finish("done", built=len(results))
+        elif results:
+            heartbeat.finish(
+                "partial", built=len(results),
+                failed_members=len(results.failed),
+                error=next(iter(results.failed.values())),
+            )
+        else:
+            heartbeat.finish(
+                "failed", built=0, failed_members=len(results.failed),
+                error=next(iter(results.failed.values())),
+            )
     return results
 
 
@@ -503,6 +607,7 @@ def _build_fleet_group(
     heartbeat=None,
     counters=None,
 ) -> None:
+    _FP_GROUP.fire()
     ae_kwargs = copy.deepcopy(group[0][1])
     if counters is None:  # direct callers (tests) outside build_fleet
         counters = _build_counters()

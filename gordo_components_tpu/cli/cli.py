@@ -21,6 +21,10 @@ EXIT_OK = 0
 EXIT_CONFIG_ERROR = 81
 EXIT_DATA_ERROR = 82
 EXIT_BUILD_ERROR = 83
+# partial fleet build: SOME members shipped, the rest are recorded as
+# failed in the manifest — distinct from EXIT_BUILD_ERROR so a retry
+# controller can tell "rerun just the failures" from "rerun everything"
+EXIT_PARTIAL_BUILD = 84
 
 
 @click.group("gordo-components-tpu")
@@ -56,6 +60,12 @@ def gordo(log_level, platform, profile_dir, compile_cache_dir):
         enable_compile_cache(compile_cache_dir)
     if profile_dir:
         os.environ["GORDO_PROFILE_DIR"] = profile_dir
+    if os.environ.get("GORDO_FAULTS"):
+        # chaos runs: arm the named faultpoints before any subsystem runs
+        # (resilience/faults.py parks specs for sites not yet imported)
+        from gordo_components_tpu.resilience import configure_from_env
+
+        configure_from_env()
 
 
 def _load_json_or_yaml(value: str):
@@ -170,7 +180,23 @@ def build_fleet_cmd(machines_file, output_dir, model_register_dir, checkpoint_di
     except Exception as exc:
         click.echo(f"Fleet build failed: {exc}", err=True)
         sys.exit(EXIT_BUILD_ERROR)
-    click.echo(json.dumps(results, indent=2))
+    # partial-manifest contract (docs/operations.md runbook): the manifest
+    # always lists built AND failed members, lands on disk next to the
+    # artifacts for the retry controller, and the exit code distinguishes
+    # "everything shipped" (0) / "partial — rerun the failed subset" (84)
+    # / "nothing shipped" (83)
+    manifest = results.manifest()
+    try:
+        os.makedirs(output_dir, exist_ok=True)
+        with open(os.path.join(output_dir, "build_manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+    except OSError as exc:
+        click.echo(f"warning: could not write build_manifest.json: {exc}", err=True)
+    click.echo(json.dumps(manifest, indent=2))
+    if results.failed and not results:
+        sys.exit(EXIT_BUILD_ERROR)
+    if results.failed:
+        sys.exit(EXIT_PARTIAL_BUILD)
 
 
 @gordo.command("checkpoint-prune")

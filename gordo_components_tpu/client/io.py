@@ -13,6 +13,37 @@ import aiohttp
 logger = logging.getLogger(__name__)
 
 
+def retry_after_seconds(value: str) -> Optional[float]:
+    """Seconds to wait from a ``Retry-After`` header value, or None.
+
+    RFC 9110 allows BOTH forms: delta-seconds (``"17"``) and an HTTP-date
+    (``"Wed, 21 Oct 2015 07:28:00 GMT"``) — our own shedding server sends
+    the integer form, but proxies and foreign peers routinely send the
+    date form, which used to be silently ignored (keeping the computed
+    exponential backoff). A date in the past clamps to 0.
+    """
+    value = value.strip()
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    from email.utils import parsedate_to_datetime
+
+    try:
+        when = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if when is None:
+        return None
+    from datetime import datetime, timezone
+
+    if when.tzinfo is None:  # RFC 5322 parse of a legacy zone-less date
+        when = when.replace(tzinfo=timezone.utc)
+    return max(0.0, (when - datetime.now(timezone.utc)).total_seconds())
+
+
 class HttpUnprocessableEntity(Exception):
     """422 — the endpoint exists but rejected the payload (no point
     retrying)."""
@@ -106,20 +137,18 @@ async def fetch_json(
             # (server/bank.py EngineOverloaded): honoring it beats blind
             # exponential backoff — the fleet-backfill storm re-offers
             # load right when capacity frees instead of too early (more
-            # sheds) or too late (idle server). Clamped: the value is
-            # server-controlled, and float('inf')/huge values must not
-            # hang the backfill
+            # sheds) or too late (idle server). Both header forms parse
+            # (delta-seconds and HTTP-date — proxies send the latter).
+            # Clamped: the value is server/proxy-controlled, and a huge or
+            # inf value must not hang the backfill
             if (
                 isinstance(exc, aiohttp.ClientResponseError)
                 and exc.headers is not None
                 and exc.headers.get("Retry-After")
             ):
-                try:
-                    delay = max(
-                        delay, min(float(exc.headers["Retry-After"]), 60.0)
-                    )
-                except ValueError:
-                    pass  # HTTP-date form: keep the computed backoff
+                hinted = retry_after_seconds(exc.headers["Retry-After"])
+                if hinted is not None:
+                    delay = max(delay, min(hinted, 60.0))
             logger.warning(
                 "Request %s %s failed (%s); retry %d/%d in %.1fs",
                 method, url, exc, attempt + 1, retries, delay,

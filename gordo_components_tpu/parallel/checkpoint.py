@@ -37,9 +37,39 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from gordo_components_tpu.resilience.faults import faultpoint
+
 logger = logging.getLogger(__name__)
 
 _KEY_RE = re.compile(r"[0-9a-f]{24}")
+
+# chaos sites (tests/test_chaos.py): a failed state write must not kill
+# the training run it protects; a corrupt/unreadable read must fall back
+# to the most recent valid checkpoint (or a fresh start), never resume
+# into garbage
+_FP_WRITE = faultpoint("checkpoint.write")
+_FP_READ = faultpoint("checkpoint.read")
+
+
+def state_digest(state_pytree: Any) -> str:
+    """Content digest of a (host-side) checkpoint state pytree.
+
+    Deterministic over the key-path traversal order, each leaf hashed as
+    shape + dtype + raw bytes — the same digest before the orbax write
+    and after a clean restore, so :meth:`FleetBucketCheckpoint.restore`
+    can detect on-disk corruption the torn-save commit marker cannot see
+    (bit rot, a truncated array file, a foreign writer on the shared
+    checkpoint volume).
+    """
+    h = hashlib.sha256()
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state_pytree)
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
 
 
 def bucket_checkpoint_key(payload: Any, data=None) -> str:
@@ -109,12 +139,17 @@ class FleetBucketCheckpoint:
             if os.path.exists(os.path.join(self.root, str(e), "host.json"))
         ]
 
-    def _commit(self, epoch: int, host_state: Dict[str, Any]) -> None:
+    def _commit(
+        self, epoch: int, host_state: Dict[str, Any], digest: Optional[str] = None
+    ) -> None:
         """Write the commit marker for ``epoch`` and prune older epochs."""
         edir = os.path.join(self.root, str(int(epoch)))
         host_path = os.path.join(edir, "host.json")
+        payload = {"epoch": int(epoch), **host_state}
+        if digest is not None:
+            payload["state_digest"] = digest
         with open(host_path + ".tmp", "w") as f:
-            json.dump({"epoch": int(epoch), **host_state}, f)
+            json.dump(payload, f)
         os.replace(host_path + ".tmp", host_path)  # commit
         for old in self._epoch_dirs():
             if old != int(epoch):
@@ -124,10 +159,10 @@ class FleetBucketCheckpoint:
     def _commit_pending(self) -> None:
         if self._pending is None:
             return
-        epoch, host_state = self._pending
+        epoch, host_state, digest = self._pending
         self._pending = None
         self._checkpointer().wait_until_finished()
-        self._commit(epoch, host_state)
+        self._commit(epoch, host_state, digest)
 
     def save(self, epoch: int, state_pytree: Any, host_state: Dict[str, Any]) -> None:
         """Persist after ``epoch`` completed.
@@ -138,6 +173,7 @@ class FleetBucketCheckpoint:
         the commit to the next ``save``/``flush``/``clear`` while the
         write proceeds in the background.
         """
+        _FP_WRITE.fire()
         edir = os.path.join(self.root, str(int(epoch)))
         if self.use_async:
             # commit (and prune for) the previous in-flight save FIRST, so
@@ -147,19 +183,23 @@ class FleetBucketCheckpoint:
             shutil.rmtree(edir)
         os.makedirs(edir)
         state_host = jax.tree.map(np.asarray, state_pytree)
+        # content digest rides in host.json: restore() re-hashes the
+        # restored pytree and rejects a checkpoint whose bytes changed on
+        # disk (the commit marker only proves the save wasn't torn)
+        digest = state_digest(state_host)
         if self.use_async:
             import copy
 
             self._checkpointer().save(os.path.join(edir, "state"), state_host)
             # deep snapshot: host_state holds LIVE lists (histories) that
             # keep growing before the deferred commit writes them out
-            self._pending = (int(epoch), copy.deepcopy(host_state))
+            self._pending = (int(epoch), copy.deepcopy(host_state), digest)
             return
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
             ckptr.save(os.path.join(edir, "state"), state_host)
-        self._commit(int(epoch), host_state)
+        self._commit(int(epoch), host_state, digest)
 
     def flush(self) -> None:
         """Wait for and commit any in-flight async save."""
@@ -177,18 +217,34 @@ class FleetBucketCheckpoint:
 
     def restore(self) -> Optional[Dict[str, Any]]:
         """Returns ``{"epoch": int, "state": pytree, **host_state}`` with
-        numpy leaves from the newest committed epoch, or None."""
+        numpy leaves from the newest committed epoch, or None.
+
+        The read side VERIFIES the stored content digest before trusting
+        the payload: a checkpoint whose state bytes no longer hash to what
+        the writer recorded (disk corruption, a truncated array on the
+        shared volume) is skipped and the next most recent valid epoch —
+        or a fresh training start — is used instead. A pre-digest
+        (legacy) checkpoint restores as before."""
         import orbax.checkpoint as ocp
 
         for epoch in reversed(self._committed_epochs()):
             edir = os.path.join(self.root, str(epoch))
             try:
+                _FP_READ.fire()
                 with open(os.path.join(edir, "host.json")) as f:
                     host = json.load(f)
                 with ocp.PyTreeCheckpointer() as ckptr:
                     state = ckptr.restore(os.path.join(edir, "state"))
             except Exception:
                 logger.warning("Unreadable fleet checkpoint at %s; skipping", edir)
+                continue
+            expected = host.pop("state_digest", None)
+            if expected is not None and state_digest(state) != expected:
+                logger.warning(
+                    "Fleet checkpoint at %s FAILED digest validation "
+                    "(on-disk corruption); falling back to the next most "
+                    "recent valid checkpoint", edir,
+                )
                 continue
             host["state"] = state
             logger.info("Resuming fleet bucket from %s (epoch %d done)", edir, epoch)

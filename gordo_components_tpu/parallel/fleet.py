@@ -1253,31 +1253,41 @@ class FleetTrainer:
                     start_epoch = 0
 
         def save_checkpoint(epoch):
-            tosave = {"state": dict(
-                (str(i), leaf) for i, leaf in enumerate(jax.tree.leaves(states))
-            )}
-            if best_params is not None:
-                tosave["best"] = dict(
-                    (str(i), leaf)
-                    for i, leaf in enumerate(jax.tree.leaves(best_params))
+            try:
+                tosave = {"state": dict(
+                    (str(i), leaf) for i, leaf in enumerate(jax.tree.leaves(states))
+                )}
+                if best_params is not None:
+                    tosave["best"] = dict(
+                        (str(i), leaf)
+                        for i, leaf in enumerate(jax.tree.leaves(best_params))
+                    )
+                # start EVERY leaf's device->host copy before the first
+                # blocking np.asarray: the copies overlap instead of paying
+                # one full round-trip per leaf (checkpoint.py then
+                # materializes them)
+                for leaf in jax.tree.leaves(tosave):
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+                ckpt.save(
+                    epoch,
+                    tosave,
+                    {
+                        "active": active.tolist(),
+                        "best": best.tolist(),
+                        "patience": patience.tolist(),
+                        "histories": histories,
+                        "histories_val": histories_val,
+                    },
                 )
-            # start EVERY leaf's device->host copy before the first blocking
-            # np.asarray: the copies overlap instead of paying one full
-            # round-trip per leaf (checkpoint.py then materializes them)
-            for leaf in jax.tree.leaves(tosave):
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
-            ckpt.save(
-                epoch,
-                tosave,
-                {
-                    "active": active.tolist(),
-                    "best": best.tolist(),
-                    "patience": patience.tolist(),
-                    "histories": histories,
-                    "histories_val": histories_val,
-                },
-            )
+            except Exception:
+                # best-effort by contract: a full checkpoint volume (or an
+                # injected checkpoint.write fault) costs resumability, not
+                # the hours of training it was protecting
+                logger.warning(
+                    "fleet checkpoint save failed at epoch %d; training "
+                    "continues without it", epoch, exc_info=True,
+                )
 
         epoch_times: List[float] = []
         sync = max(1, int(self.host_sync_every))

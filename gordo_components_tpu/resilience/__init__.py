@@ -1,0 +1,50 @@
+"""Fleet resilience: deterministic fault injection + graceful degradation.
+
+The platform's whole value is keeping thousands of per-machine models
+built and servable when individual artifacts, pods, or scrapes fail
+(PAPER.md §0: one corrupt artifact must not take down a fleet), and at
+TPU-fleet scale the dominant efficiency loss is unhandled failures, not
+raw step time ("ML Productivity Goodput", PAPERS.md). The defenses are
+only real if they can be *exercised*: this package provides
+
+- :mod:`faults` — a registry of named **faultpoints** threaded through
+  the real failure sites (artifact load, bucket compile, scoring,
+  engine queue, watchman scrapes, fleet-group training, checkpoint IO).
+  Disabled by default with near-zero hot-path cost; armed per-site from
+  code or the ``GORDO_FAULTS`` env var with deterministic raise-N-times,
+  seeded probabilistic raise, and injected-latency modes. The chaos
+  suite (``tests/test_chaos.py``, ``make chaos``) drives every
+  registered site one at a time through the public HTTP/build APIs and
+  asserts the process survives in its documented degraded state.
+- :mod:`quarantine` — :class:`QuarantineSet`, the serving-side breaker:
+  a model that repeatedly fails scoring or emits non-finite scores is
+  evicted from routing (410 with a reason instead of a crash-retry
+  loop) while the rest of the collection keeps serving; the server's
+  tri-state ``/healthz`` reports ``degraded`` instead of flapping.
+"""
+
+from gordo_components_tpu.resilience.faults import (
+    FaultInjected,
+    FaultSpec,
+    arm,
+    configure_from_env,
+    disarm,
+    fault_stats,
+    faultpoint,
+    registered_sites,
+    reset,
+)
+from gordo_components_tpu.resilience.quarantine import QuarantineSet
+
+__all__ = [
+    "FaultInjected",
+    "FaultSpec",
+    "QuarantineSet",
+    "arm",
+    "configure_from_env",
+    "disarm",
+    "fault_stats",
+    "faultpoint",
+    "registered_sites",
+    "reset",
+]

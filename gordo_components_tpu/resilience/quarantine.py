@@ -1,0 +1,128 @@
+"""Serving-side model quarantine: the per-model circuit breaker.
+
+A model whose scoring keeps failing — a poisoned artifact, a bucket
+program that emits NaN for its slot, a divergence that trained thresholds
+can't mask — must not keep absorbing requests through the crash-retry
+path forever, and must *definitely* not take the rest of the collection
+down with it. :class:`QuarantineSet` counts consecutive scoring failures
+(exceptions and non-finite outputs both) per model; at ``threshold`` the
+model is evicted from routing: ``/prediction`` answers 410 with the
+recorded reason, the name is listed in ``/stats`` and the
+``gordo_quarantined_models`` gauge, and the server's tri-state
+``/healthz`` reports ``degraded`` (not ``unhealthy`` — the healthy subset
+is still serving, and a flapping liveness probe would turn one bad model
+into a fleet-wide restart storm).
+
+Clearing is an operator action (``POST .../quarantine/clear``) or a
+``/reload`` that actually replaces the model — matching the runbook in
+``docs/operations.md``.
+
+Single-writer contract: all mutation happens on the aiohttp event-loop
+thread (the same contract ``app["stats"]`` relies on); plain dict/int
+state needs no locks.
+"""
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_THRESHOLD = 3
+
+
+class QuarantineSet:
+    """Consecutive-failure breaker over model names.
+
+    ``threshold <= 0`` disables quarantining entirely (records nothing,
+    contains nothing) — the operator's escape hatch.
+    """
+
+    def __init__(self, threshold: int = DEFAULT_THRESHOLD):
+        self.threshold = int(threshold)
+        self._failures: Dict[str, int] = {}  # pre-quarantine streaks
+        self._last_reason: Dict[str, str] = {}
+        self._quarantined: Dict[str, Dict[str, Any]] = {}
+
+    # --------------------------- recording ---------------------------- #
+
+    def record_failure(self, name: str, reason: str) -> bool:
+        """Count one scoring failure; returns True when this failure
+        newly quarantines the model."""
+        if self.threshold <= 0 or name in self._quarantined:
+            return False
+        streak = self._failures.get(name, 0) + 1
+        self._failures[name] = streak
+        self._last_reason[name] = reason
+        if streak < self.threshold:
+            return False
+        self._quarantined[name] = {
+            "reason": reason,
+            "failures": streak,
+            "since": time.time(),
+        }
+        self._failures.pop(name, None)
+        self._last_reason.pop(name, None)
+        logger.error(
+            "Model %r QUARANTINED after %d consecutive scoring failures "
+            "(last: %s); /prediction now answers 410 until cleared",
+            name, streak, reason,
+        )
+        return True
+
+    def record_success(self, name: str) -> None:
+        """A good score resets the pre-quarantine streak (quarantined
+        models never reach scoring, so there is nothing to reset there)."""
+        if self._failures:
+            self._failures.pop(name, None)
+            self._last_reason.pop(name, None)
+
+    # ---------------------------- queries ----------------------------- #
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._quarantined
+
+    def __len__(self) -> int:
+        return len(self._quarantined)
+
+    def reason(self, name: str) -> Optional[Dict[str, Any]]:
+        return self._quarantined.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._quarantined)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Operator view for ``/stats`` and ``GET .../quarantine``."""
+        return {
+            "threshold": self.threshold,
+            "quarantined": {
+                name: dict(info) for name, info in sorted(self._quarantined.items())
+            },
+            "failing": {
+                name: {"failures": n, "last_reason": self._last_reason.get(name, "")}
+                for name, n in sorted(self._failures.items())
+            },
+        }
+
+    # --------------------------- clearing ----------------------------- #
+
+    def clear(self, names: Optional[List[str]] = None) -> List[str]:
+        """Clear specific models (or everything when ``names`` is None);
+        returns the names actually cleared. Their failure streaks restart
+        from zero — a cleared model gets a full fresh allowance."""
+        targets = sorted(self._quarantined) if names is None else names
+        cleared = []
+        for name in targets:
+            if self._quarantined.pop(name, None) is not None:
+                cleared.append(name)
+            self._failures.pop(name, None)
+            self._last_reason.pop(name, None)
+        if cleared:
+            logger.warning("Quarantine cleared for: %s", ", ".join(cleared))
+        return cleared
+
+    def drop(self, name: str) -> None:
+        """Forget all state for a removed/replaced model (reload path)."""
+        self._quarantined.pop(name, None)
+        self._failures.pop(name, None)
+        self._last_reason.pop(name, None)

@@ -17,6 +17,7 @@ from typing import Optional
 from aiohttp import web
 
 from gordo_components_tpu.observability import MetricsRegistry
+from gordo_components_tpu.resilience import QuarantineSet, configure_from_env
 from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 from gordo_components_tpu.server.model_io import ModelCollection
 from gordo_components_tpu.server.stats import LatencyHistogram
@@ -133,6 +134,27 @@ def _server_collector(app: web.Application):
                 "Models loaded in the collection", {},
                 len(collection.models),
             )
+            # corrupt-artifact visibility (the healthy-subset fallback
+            # used to be invisible to operators): total failed load
+            # attempts (counter; nonzero rate = an artifact is STILL
+            # failing every refresh) + the current failed set's size
+            yield (
+                "gordo_models_load_failed_total", "counter",
+                "Artifact load attempts that failed (corrupt/mid-write)",
+                {}, collection.load_failed_total,
+            )
+            yield (
+                "gordo_models_load_failed", "gauge",
+                "Artifacts failing to load as of the latest scan", {},
+                len(collection.load_failures),
+            )
+        quarantine = app.get("quarantine")
+        if quarantine is not None:
+            yield (
+                "gordo_quarantined_models", "gauge",
+                "Models evicted from routing by the scoring-failure "
+                "breaker (410 until cleared)", {}, len(quarantine),
+            )
 
     return collect
 
@@ -164,6 +186,7 @@ def build_app(
     bank_max_batch: int = 64,
     bank_max_queue: Optional[int] = None,
     devices: Optional[int] = None,
+    quarantine_threshold: Optional[int] = None,
 ) -> web.Application:
     """App factory: loads the artifact(s) under ``model_dir`` once.
 
@@ -195,6 +218,9 @@ def build_app(
                 + (f" ({hint})" if hint else "")
             ) from None
 
+    # chaos/fault config: arms any GORDO_FAULTS sites before the first
+    # artifact load / bucket compile can hit them; no-op when unset
+    configure_from_env()
     if use_bank is None:
         use_bank = os.environ.get("GORDO_SERVER_BANK", "1") != "0"
     if devices is None:
@@ -238,6 +264,19 @@ def build_app(
     registry.collector(_hbm_collector(), key="hbm")
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
+    # per-model scoring-failure breaker (resilience/quarantine.py): a
+    # model that keeps failing or emitting NaN is evicted from routing
+    # (410 + reason) instead of crash-looping requests; /healthz reports
+    # the tri-state (ok/degraded/unhealthy) over quarantine + load state
+    if quarantine_threshold is None:
+        from gordo_components_tpu.resilience.quarantine import DEFAULT_THRESHOLD
+
+        quarantine_threshold = env_int(
+            "GORDO_QUARANTINE_THRESHOLD",
+            str(DEFAULT_THRESHOLD),
+            hint="consecutive scoring failures before eviction; <=0 disables",
+        )
+    app["quarantine"] = QuarantineSet(threshold=quarantine_threshold)
     app["bank_enabled"] = use_bank
     if bank_max_queue is None and os.environ.get("GORDO_BANK_MAX_QUEUE"):
         # operator backpressure knob: how deep the scoring queue may grow

@@ -44,8 +44,16 @@ from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.models.train_core import _next_pow2
 from gordo_components_tpu.observability import get_registry
 from gordo_components_tpu.ops.scaler import ScalerParams
+from gordo_components_tpu.resilience.faults import faultpoint
 
 logger = logging.getLogger(__name__)
+
+# chaos sites (tests/test_chaos.py): bucket stack/compile, batched scoring
+# dispatch, and engine admission. Module-level points so the disabled cost
+# on the serving hot loop is one attribute check (see the 5% guard test).
+_FP_FINALIZE = faultpoint("bank.finalize")
+_FP_SCORE = faultpoint("bank.score")
+_FP_ENGINE_QUEUE = faultpoint("engine.queue")
 
 
 # --------------------------------------------------------------------- #
@@ -256,6 +264,7 @@ class _Bucket:
         self.names.append(entry.name)
 
     def finalize(self) -> None:
+        _FP_FINALIZE.fire()
         entries = self._entries
         sharding = None
         if self.mesh is not None:
@@ -434,6 +443,11 @@ class ModelBank:
         self._tags: Dict[str, List[str]] = {}
         # name -> human-readable reason the model serves per-model instead
         self.fallback: Dict[str, str] = {}
+        # bucket label -> error for buckets whose finalize (stack/compile)
+        # failed: those members still serve via the per-model path, but
+        # unlike the by-design fallback set this is an IMPAIRMENT —
+        # /healthz reports degraded while any entry is present
+        self.finalize_failures: Dict[str, str] = {}
         # metrics registry (observability/): None = process default,
         # False = uninstrumented (the hot-loop overhead guard's control).
         # The router records per-shard routed/padded-row counters here —
@@ -556,8 +570,29 @@ class ModelBank:
             bank._tags[name] = (
                 list(tags) if tags else [f"feature-{i}" for i in range(entry.n_features)]
             )
-        for bucket in bank._buckets.values():
-            bucket.finalize()
+        # per-bucket finalize isolation: one bucket whose stack/compile
+        # fails (OOM on a huge stack, a factory bug for one architecture,
+        # an injected fault) must not abort bank construction — its
+        # members fall back to the per-model scoring path with the reason
+        # surfaced through coverage()/GET /models, and every OTHER bucket
+        # still serves from HBM
+        for key in list(bank._buckets):
+            bucket = bank._buckets[key]
+            try:
+                bucket.finalize()
+            except Exception as exc:
+                logger.error(
+                    "Bucket %s finalize FAILED (%d member(s) fall back to "
+                    "the per-model path): %s",
+                    bucket.label, len(bucket.names), exc, exc_info=True,
+                )
+                del bank._buckets[key]
+                reason = f"bucket finalize failed: {type(exc).__name__}: {exc}"
+                bank.finalize_failures[bucket.label] = reason
+                for name in bucket.names:
+                    bank._index.pop(name, None)
+                    bank._tags.pop(name, None)
+                    bank.fallback[name] = reason
         if bank._index:
             logger.info(
                 "Model bank: %d models in %d bucket(s)%s",
@@ -648,6 +683,7 @@ class ModelBank:
         Requests are grouped by bucket, padded to pow2 (batch, rows) and
         scored in one XLA call per group.
         """
+        _FP_SCORE.fire()
         results: List[Optional[ScoreResult]] = [None] * len(requests)
         by_bucket: Dict[str, List[int]] = {}
         for ri, (name, X, _y) in enumerate(requests):
@@ -959,6 +995,7 @@ class BatchingEngine:
         y: Optional[np.ndarray] = None,
         request_id: Optional[str] = None,
     ) -> ScoreResult:
+        _FP_ENGINE_QUEUE.fire()
         self.start()
         depth = self._queue.qsize()
         if depth >= self.max_queue:

@@ -13,8 +13,14 @@ import os
 from typing import Any, Dict, Optional
 
 from gordo_components_tpu import serializer
+from gordo_components_tpu.resilience.faults import faultpoint
 
 logger = logging.getLogger(__name__)
+
+# chaos site: artifact deserialization (tests/test_chaos.py drives it);
+# firing inside _load_one lands the failure in refresh()'s per-entry
+# isolation, exactly where a truly corrupt artifact would surface
+_FP_LOAD = faultpoint("model_io.load")
 
 
 class ModelCollection:
@@ -41,6 +47,14 @@ class ModelCollection:
         # cross-dict consistency matters.
         self._state: tuple = ({}, {})
         self._mtimes: Dict[str, float] = {}
+        # operator-visible corrupt-artifact accounting: the healthy-subset
+        # fallback below must not be invisible. ``load_failures`` is the
+        # CURRENT failed set (latest scan); ``load_failed_total`` counts
+        # every failed load attempt monotonically (each retrying refresh
+        # increments it again — that is what a Prometheus counter wants,
+        # rate() > 0 means "still failing")
+        self.load_failures: Dict[str, str] = {}
+        self.load_failed_total: int = 0
         changes = self.refresh()
         if not self.models:
             detail = (
@@ -141,6 +155,8 @@ class ModelCollection:
             (added if is_new else updated).append(name)
         self._state = (models, metadata)  # atomic publish
         self._mtimes = mtimes
+        self.load_failures = dict(failed)
+        self.load_failed_total += len(failed)
         if added or updated or removed or failed:
             logger.info(
                 "Collection refresh: +%d ~%d -%d !%d (now %d models)",
@@ -154,6 +170,7 @@ class ModelCollection:
     @staticmethod
     def _load_one(models: Dict, metadata: Dict, name: str, path: str) -> None:
         logger.info("Loading model %r from %s", name, path)
+        _FP_LOAD.fire()
         # assign only after BOTH loads succeed: a metadata failure must
         # not leave a model without its metadata in the staged dicts
         model = serializer.load(path)

@@ -66,6 +66,57 @@ def _bank_engine(request: web.Request):
     return None
 
 
+def _quarantine_gate(request: web.Request) -> None:
+    """410 Gone (with the recorded reason) for a quarantined target — the
+    model EXISTS but was evicted from routing by the failure breaker
+    (resilience/quarantine.py); a 404 would lie to the operator and a
+    crash-retry loop would keep burning capacity on a poisoned model."""
+    quarantine = request.app.get("quarantine")
+    target = request.match_info["target"]
+    if quarantine is None or target not in quarantine:
+        return
+    info = quarantine.reason(target) or {}
+    raise web.HTTPGone(
+        text=json.dumps(
+            {
+                "error": f"Model {target!r} is quarantined",
+                "reason": info.get("reason"),
+                "failures": info.get("failures"),
+                "since": info.get("since"),
+                "clear": f"POST /gordo/v0/{request.match_info['project']}"
+                         "/quarantine/clear",
+            }
+        ),
+        content_type="application/json",
+    )
+
+
+def _note_scoring_result(request: web.Request, target: str, X, values) -> None:
+    """Record a completed score with the quarantine breaker: finite
+    output resets the failure streak; non-finite output (NaN/Inf anywhere
+    in ``values``) counts as a failure — UNLESS the request's own input
+    was non-finite, which is the client's data, not the model's fault.
+    The input scan only runs on the (rare) non-finite path."""
+    quarantine = request.app.get("quarantine")
+    if quarantine is None:
+        return
+    arr = np.asarray(values)
+    if np.all(np.isfinite(arr)):
+        quarantine.record_success(target)
+    elif np.all(np.isfinite(np.asarray(X.values, dtype="float64"))):
+        quarantine.record_failure(target, "non-finite scores in model output")
+
+
+def _note_scoring_error(request: web.Request, target: str, exc: Exception) -> None:
+    """Count a scoring exception against the quarantine breaker.
+    Input-shape complaints (ValueError/KeyError) are the request's fault,
+    not the model's, and never count."""
+    quarantine = request.app.get("quarantine")
+    if quarantine is None or isinstance(exc, (ValueError, KeyError)):
+        return
+    quarantine.record_failure(target, f"{type(exc).__name__}: {exc}")
+
+
 def _http_overloaded(exc: EngineOverloaded) -> web.HTTPTooManyRequests:
     """429 with a drain-estimate Retry-After for a shed request."""
     return web.HTTPTooManyRequests(
@@ -128,6 +179,80 @@ async def readiness(request: web.Request) -> web.Response:
     return web.json_response(body, status=200 if n > 0 else 503)
 
 
+def _healthz_body(app: web.Application) -> tuple:
+    """Tri-state process health: ``ok`` | ``degraded`` | ``unhealthy``.
+
+    ``degraded`` (still HTTP 200 — a liveness/readiness probe must NOT
+    flap and restart a process that is serving its healthy majority)
+    means a subset is impaired: models quarantined by the failure
+    breaker, or artifacts the collection could not load on its latest
+    scan. ``unhealthy`` (503) means nothing is servable. The body always
+    says WHY, so "degraded" is a pager link, not a mystery."""
+    collection = app.get("collection")
+    quarantine = app.get("quarantine")
+    bank = app.get("bank")
+    models = len(collection.models) if collection is not None else 0
+    load_failures = dict(collection.load_failures) if collection is not None else {}
+    quarantined = quarantine.snapshot()["quarantined"] if quarantine is not None else {}
+    finalize_failures = dict(getattr(bank, "finalize_failures", None) or {})
+    if models == 0:
+        status, http = "unhealthy", 503
+    elif quarantined or load_failures or finalize_failures:
+        status, http = "degraded", 200
+    else:
+        status, http = "ok", 200
+    return {
+        "status": status,
+        "models": models,
+        "quarantined": quarantined,
+        "load_failures": load_failures,
+        "bank_finalize_failures": finalize_failures,
+    }, http
+
+
+@routes.get("/healthz")
+@routes.get("/gordo/v0/{project}/healthz")
+async def healthz(request: web.Request) -> web.Response:
+    body, status = _healthz_body(request.app)
+    return web.json_response(body, status=status)
+
+
+@routes.get("/gordo/v0/{project}/quarantine")
+async def quarantine_list(request: web.Request) -> web.Response:
+    quarantine = request.app.get("quarantine")
+    if quarantine is None:
+        return web.json_response({"enabled": False})
+    return web.json_response({"enabled": True, **quarantine.snapshot()})
+
+
+@routes.post("/gordo/v0/{project}/quarantine/clear")
+async def quarantine_clear(request: web.Request) -> web.Response:
+    """Operator action (see docs/operations.md runbook): re-admit
+    quarantined models to routing. Body ``{"targets": [...]}`` clears the
+    named models; an empty/absent body clears everything."""
+    quarantine = request.app.get("quarantine")
+    if quarantine is None:
+        return web.json_response({"enabled": False, "cleared": []})
+    targets = None
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "expected a JSON body"}),
+                content_type="application/json",
+            )
+        if body:
+            targets = body.get("targets")
+            if targets is not None and not isinstance(targets, list):
+                raise web.HTTPBadRequest(
+                    text=json.dumps({"error": "targets must be a list"}),
+                    content_type="application/json",
+                )
+    cleared = quarantine.clear(targets)
+    return web.json_response({"enabled": True, "cleared": cleared})
+
+
 @routes.get("/gordo/v0/{project}/metrics")
 async def metrics_exposition(request: web.Request) -> web.Response:
     """Prometheus text-format exposition of the app's metrics registry
@@ -186,6 +311,17 @@ async def server_stats(request: web.Request) -> web.Response:
     bank = request.app.get("bank")
     if bank is not None:
         body["bank_models"] = len(bank)
+    quarantine = request.app.get("quarantine")
+    if quarantine is not None:
+        # the degraded-mode surface: which models the breaker evicted
+        # (and why), plus the pre-quarantine failure streaks in flight
+        body["quarantine"] = quarantine.snapshot()
+    collection = request.app.get("collection")
+    if collection is not None:
+        body["load_failures"] = {
+            "current": dict(collection.load_failures),
+            "total": collection.load_failed_total,
+        }
     registry = request.app.get("metrics")
     if registry is not None:
         # the registry's JSON view: the SAME cells /metrics renders (per-
@@ -268,6 +404,12 @@ async def reload_models(request: web.Request) -> web.Response:
     loop = asyncio.get_running_loop()
     async with lock:
         changes = await loop.run_in_executor(None, collection.refresh)
+        quarantine = app.get("quarantine")
+        if quarantine is not None:
+            # a replaced or removed artifact gets a clean slate: the
+            # quarantine verdict belonged to the OLD bytes
+            for name in changes["updated"] + changes["removed"]:
+                quarantine.drop(name)
         bank_models = None
         if app.get("bank_enabled"):
             from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
@@ -365,6 +507,8 @@ async def _parse_request(request: web.Request):
 @routes.post("/gordo/v0/{project}/{target}/prediction")
 async def prediction(request: web.Request) -> web.Response:
     model, _ = _get_model(request)
+    _quarantine_gate(request)
+    target = request.match_info["target"]
     try:
         X, _y = await _parse_request(request)
     except ValueError as exc:
@@ -375,7 +519,7 @@ async def prediction(request: web.Request) -> web.Response:
     try:
         if engine is not None:
             result = await engine.score(
-                request.match_info["target"],
+                target,
                 X.values.astype("float32"),
                 request_id=request.get("request_id"),
             )
@@ -388,11 +532,13 @@ async def prediction(request: web.Request) -> web.Response:
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
     except Exception as exc:  # surface model errors as 400s with detail
+        _note_scoring_error(request, target, exc)
         logger.exception("prediction failed")
         raise web.HTTPBadRequest(
             text=json.dumps({"error": f"{type(exc).__name__}: {exc}"}),
             content_type="application/json",
         )
+    _note_scoring_result(request, target, X, output)
     out_index = X.index[len(X) - len(output):]
     return web.json_response(
         {
@@ -410,6 +556,8 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
             text=json.dumps({"error": "Model does not support anomaly scoring"}),
             content_type="application/json",
         )
+    _quarantine_gate(request)
+    target = request.match_info["target"]
     try:
         X, y = await _parse_request(request)
     except ValueError as exc:
@@ -420,7 +568,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     try:
         if engine is not None:
             result = await engine.score(
-                request.match_info["target"],
+                target,
                 X.values.astype("float32"),
                 None if y is None else y.values.astype("float32"),
                 request_id=request.get("request_id"),
@@ -432,9 +580,16 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
     except Exception as exc:
+        _note_scoring_error(request, target, exc)
         logger.exception("anomaly scoring failed")
         raise web.HTTPBadRequest(
             text=json.dumps({"error": f"{type(exc).__name__}: {exc}"}),
             content_type="application/json",
         )
+    # NaN anywhere in the model's reconstruction propagates into the
+    # total columns (sums of NaN), so the totals are a cheap O(rows)
+    # whole-frame finiteness proxy for the breaker
+    _note_scoring_result(
+        request, target, X, frame[("total-anomaly-scaled", "")].to_numpy()
+    )
     return web.json_response(frame_to_dict(frame))

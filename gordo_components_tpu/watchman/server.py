@@ -26,8 +26,16 @@ from aiohttp import web
 
 from gordo_components_tpu import __version__
 from gordo_components_tpu.observability import parse_prometheus_text, render_samples
+from gordo_components_tpu.resilience.faults import faultpoint
 
 logger = logging.getLogger(__name__)
+
+# chaos sites (tests/test_chaos.py): replica /metrics scrapes and the
+# health-snapshot refresh. Both degrade to last-good-with-a-stale-stamp,
+# never an error — a monitoring plane that dies with what it monitors is
+# worthless exactly when it matters
+_FP_SCRAPE = faultpoint("watchman.scrape")
+_FP_SNAPSHOT = faultpoint("watchman.snapshot")
 
 
 def aggregate_fleet_metrics(
@@ -157,7 +165,30 @@ def render_fleet_metrics(agg: Dict[str, Any]) -> str:
         ),
         "gordo_fleet_shard_routed_rows_max": "Hottest shard's routed rows",
         "gordo_fleet_shard_routed_rows_mean": "Mean routed rows per shard",
+        "gordo_fleet_scrape_stale_seconds": (
+            "Seconds since each replica's /metrics last answered; a "
+            "missed scrape keeps the replica's last-good numbers in the "
+            "rollup (counters stay monotonic) and THIS gauge is how the "
+            "substitution stays visible. ~0 = fresh"
+        ),
     }
+    # per-replica scrape freshness, aged live at render time; a replica
+    # that has NEVER answered has no last-good body to freeze and already
+    # shows up via replicas_scraped, so it gets no sample here
+    last_success = agg.get("replica_last_success") or []
+    if any(ts is not None for ts in last_success):
+        now_mono = time.monotonic()
+        types["gordo_fleet_scrape_stale_seconds"] = "gauge"
+        for i, ts in enumerate(last_success):
+            if ts is None:
+                continue
+            samples.append(
+                (
+                    "gordo_fleet_scrape_stale_seconds",
+                    {"replica": str(i)},
+                    round(max(0.0, now_mono - ts), 3),
+                )
+            )
     if agg["shard_skew_ratio"] is not None:
         samples.append(
             ("gordo_fleet_shard_skew_ratio", {}, float(agg["shard_skew_ratio"]))
@@ -214,6 +245,11 @@ class WatchmanState:
         # exports never DROP (Prometheus would read the dip-and-recover as
         # a counter reset and report a spurious rate() burst)
         self._metrics_last_texts: List[Optional[str]] = []
+        # ...and WHEN each replica last answered (monotonic seconds): the
+        # substitution must not be silent — the rollup exports
+        # gordo_fleet_scrape_stale_seconds per replica so "this replica's
+        # numbers are frozen" is an alertable gauge, not a mystery
+        self._metrics_last_success: List[Optional[float]] = []
         self._metrics_task: Optional[asyncio.Task] = None
         # digest polling by default (VERDICT r3 next #5): a 10k-model
         # snapshot with per-epoch training histories is tens of MB of JSON
@@ -343,6 +379,7 @@ class WatchmanState:
                             return await resp.text()
 
                     try:
+                        _FP_SCRAPE.fire()
                         return await asyncio.wait_for(get(), timeout=10.0)
                     except asyncio.CancelledError:
                         raise
@@ -358,6 +395,14 @@ class WatchmanState:
                     await asyncio.gather(*(scrape(u) for u in urls))
                 )
             live_count = sum(1 for t in texts if t is not None)
+            # per-replica freshness BEFORE the last-good substitution: a
+            # replica serving frozen numbers is stale, not live
+            mono = time.monotonic()
+            succ = self._metrics_last_success
+            succ.extend([None] * (len(texts) - len(succ)))
+            for i, t in enumerate(texts):
+                if t is not None:
+                    succ[i] = mono
             # freeze failed replicas at their last successful body: summed
             # counters must stay monotonic across a transient scrape miss
             last = self._metrics_last_texts
@@ -372,6 +417,10 @@ class WatchmanState:
             # report LIVE replicas, not stale substitutions — the operator
             # signal "a replica stopped answering" must survive freezing
             self._metrics_cache["replicas_scraped"] = live_count
+            # monotonic last-answer times ride in the aggregate so the
+            # exposition computes LIVE staleness at render time (a rollup
+            # served from cache between scrapes keeps aging honestly)
+            self._metrics_cache["replica_last_success"] = list(succ)
             # next scrape's delta baseline: keep the last non-None rows
             # per replica so a transient scrape failure doesn't reset the
             # window to lifetime
@@ -389,73 +438,108 @@ class WatchmanState:
             now = time.monotonic()
             if self._cache is not None and now - self._cache_time < self.refresh_interval:
                 return self._cache
-            timeout = aiohttp.ClientTimeout(total=30)
-            sem = asyncio.Semaphore(self.parallelism)
-            async with aiohttp.ClientSession(timeout=timeout) as session:
-                batched = await self._fetch_metadata_all(session)
-                if batched is not None:
-                    # stats is decoration-only: fetch it CONCURRENTLY with
-                    # the endpoint assembly so a slow /stats can't add its
-                    # deadline to every cache refresh held under the lock
-                    (endpoints, bank), stats = await asyncio.gather(
-                        self._snapshot_from_batched(session, sem, batched),
-                        self._fetch_stats(session),
+            try:
+                _FP_SNAPSHOT.fire()
+                return await self._refresh_snapshot(now)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # last-good retention: a refresh that blows up (a peer
+                # speaking garbage, a DNS flap, an injected fault) serves
+                # the previous snapshot STAMPED stale instead of a 500 —
+                # and leaves the cache timestamp alone so the next request
+                # retries the refresh immediately
+                if self._cache is not None:
+                    age = now - self._cache_time
+                    logger.error(
+                        "watchman snapshot refresh failed (%s); serving "
+                        "last-good snapshot (%.0fs old)", exc, age,
                     )
-                    return await self._finish_snapshot(
-                        endpoints, bank, now, stats
-                    )
-                # /models carries both the target list and the HBM bank
-                # coverage (which models score from the stacked bank vs
-                # the per-model fallback, and why) — fetched even with an
-                # explicit target list so operators see serving coverage
-                # fleet-wide. With an explicit list it runs concurrently
-                # with the health poll AND under its own short deadline:
-                # the outer gather still waits for it, so without the
-                # wait_for a hung collection endpoint would stall the
-                # refresh by the full 30s client timeout for data that is
-                # coverage-only decoration.
+                    stale = dict(self._cache)
+                    stale["stale"] = True
+                    stale["stale_seconds"] = round(age, 1)
+                    stale["refresh_error"] = f"{type(exc).__name__}: {exc}"
+                    return stale
+                logger.error(
+                    "watchman snapshot refresh failed with no last-good "
+                    "snapshot to serve", exc_info=True,
+                )
+                return {
+                    "project_name": self.project,
+                    "gordo-watchman-version": __version__,
+                    "endpoints": [],
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
 
-                async def fetch_models(deadline: Optional[float] = None):
-                    async def get():
-                        async with session.get(
-                            f"{self.base_url}/gordo/v0/{self.project}/models"
-                        ) as resp:
-                            return await resp.json()
+    async def _refresh_snapshot(self, now: float) -> Dict[str, Any]:
+        """One full snapshot refresh (runs under ``self._lock``)."""
+        timeout = aiohttp.ClientTimeout(total=30)
+        sem = asyncio.Semaphore(self.parallelism)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            batched = await self._fetch_metadata_all(session)
+            if batched is not None:
+                # stats is decoration-only: fetch it CONCURRENTLY with
+                # the endpoint assembly so a slow /stats can't add its
+                # deadline to every cache refresh held under the lock
+                (endpoints, bank), stats = await asyncio.gather(
+                    self._snapshot_from_batched(session, sem, batched),
+                    self._fetch_stats(session),
+                )
+                return await self._finish_snapshot(
+                    endpoints, bank, now, stats
+                )
+            # /models carries both the target list and the HBM bank
+            # coverage (which models score from the stacked bank vs
+            # the per-model fallback, and why) — fetched even with an
+            # explicit target list so operators see serving coverage
+            # fleet-wide. With an explicit list it runs concurrently
+            # with the health poll AND under its own short deadline:
+            # the outer gather still waits for it, so without the
+            # wait_for a hung collection endpoint would stall the
+            # refresh by the full 30s client timeout for data that is
+            # coverage-only decoration.
 
-                    if deadline is None:
-                        return await get()
-                    return await asyncio.wait_for(get(), timeout=deadline)
+            async def fetch_models(deadline: Optional[float] = None):
+                async def get():
+                    async with session.get(
+                        f"{self.base_url}/gordo/v0/{self.project}/models"
+                    ) as resp:
+                        return await resp.json()
 
-                bank = None
-                targets = self.targets
-                if targets is None:
-                    try:
-                        body = await fetch_models()
-                        bank = body.get("bank")
-                        targets = body["models"]
-                    except Exception as exc:
-                        logger.warning("target discovery failed: %s", exc)
-                        targets = []
-                    results = await asyncio.gather(
+                if deadline is None:
+                    return await get()
+                return await asyncio.wait_for(get(), timeout=deadline)
+
+            bank = None
+            targets = self.targets
+            if targets is None:
+                try:
+                    body = await fetch_models()
+                    bank = body.get("bank")
+                    targets = body["models"]
+                except Exception as exc:
+                    logger.warning("target discovery failed: %s", exc)
+                    targets = []
+                results = await asyncio.gather(
+                    *(self._check_target(session, sem, t) for t in targets)
+                )
+            else:
+                results, models_body = await asyncio.gather(
+                    asyncio.gather(
                         *(self._check_target(session, sem, t) for t in targets)
-                    )
+                    ),
+                    fetch_models(deadline=10.0),
+                    return_exceptions=True,
+                )
+                if isinstance(results, BaseException):
+                    raise results
+                if isinstance(models_body, BaseException):
+                    # coverage-only fetch: targets are intact, so this
+                    # is diagnostic noise, not a discovery failure
+                    logger.debug("bank coverage fetch failed: %s", models_body)
                 else:
-                    results, models_body = await asyncio.gather(
-                        asyncio.gather(
-                            *(self._check_target(session, sem, t) for t in targets)
-                        ),
-                        fetch_models(deadline=10.0),
-                        return_exceptions=True,
-                    )
-                    if isinstance(results, BaseException):
-                        raise results
-                    if isinstance(models_body, BaseException):
-                        # coverage-only fetch: targets are intact, so this
-                        # is diagnostic noise, not a discovery failure
-                        logger.debug("bank coverage fetch failed: %s", models_body)
-                    else:
-                        bank = models_body.get("bank")
-            return await self._finish_snapshot(list(results), bank, now)
+                    bank = models_body.get("bank")
+        return await self._finish_snapshot(list(results), bank, now)
 
     async def _snapshot_from_batched(
         self, session, sem, batched: Dict[str, Any]
@@ -557,12 +641,22 @@ def build_watchman_app(
         # scrape timeout — it serves the last rollup and refreshes in the
         # background
         agg = await state.fleet_metrics(wait=False)
-        if agg is not None and agg["replicas_scraped"]:
+        last_success = (agg or {}).get("replica_last_success") or []
+        if agg is not None and (
+            agg["replicas_scraped"] or any(t is not None for t in last_success)
+        ):
             body["fleet-metrics"] = {
                 "replicas_scraped": agg["replicas_scraped"],
                 "shard_skew_ratio": agg["shard_skew_ratio"],
                 "skew_window": agg["skew_window"],
                 "routed_rows_by_shard": agg["routed_rows_by_shard"],
+                # live per-replica scrape age: ~0 = fresh, large = the
+                # rollup is carrying this replica's last-good numbers
+                "scrape_stale_seconds": {
+                    str(i): round(max(0.0, time.monotonic() - ts), 1)
+                    for i, ts in enumerate(last_success)
+                    if ts is not None
+                },
             }
         return web.json_response(body)
 

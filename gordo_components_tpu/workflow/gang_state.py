@@ -77,6 +77,12 @@ class GangHeartbeat:
         self.update(phase=status, **fields)
 
 
+# phases after which a gang is finished and can never be "stale", however
+# old its last write: done (all built), failed (nothing built), partial
+# (partial manifest shipped — some groups failed, the rest built)
+TERMINAL_PHASES = ("done", "failed", "partial")
+
+
 def read_gang_states(
     state_dir: str, stale_after: float = 120.0
 ) -> List[Dict[str, Any]]:
@@ -100,7 +106,7 @@ def read_gang_states(
             age = now - float(state.get("ts", 0))
             state["age_seconds"] = round(age, 1)
             state["stale"] = bool(
-                age > stale_after and state.get("phase") not in ("done", "failed")
+                age > stale_after and state.get("phase") not in TERMINAL_PHASES
             )
         except Exception:
             # a malformed state file (foreign writer, manual edits) must

@@ -1,0 +1,697 @@
+"""Chaos suite: drive every registered faultpoint (resilience/faults.py)
+through the PUBLIC HTTP/build APIs and assert the process survives in its
+documented degraded state — never a crash, never a silent ``ok``. This is
+the standing regression harness for robustness work: a new failure site
+gets a faultpoint and a test here.
+
+Run via ``make chaos`` (``pytest -m chaos``); the fleet-build cases are
+additionally marked ``slow`` so the fast tier-1 subset stays under its
+timeout.
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import resilience, serializer
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+from gordo_components_tpu.resilience import FaultInjected
+from gordo_components_tpu.resilience.faults import FaultSpec
+from gordo_components_tpu.server import build_app
+
+pytestmark = pytest.mark.chaos
+
+# every failure site the stack declares; a new faultpoint must be added
+# here (and get a test) or this list fails the suite
+EXPECTED_SITES = {
+    "bank.finalize",
+    "bank.score",
+    "checkpoint.read",
+    "checkpoint.write",
+    "engine.queue",
+    "fleet_build.group",
+    "model_io.load",
+    "watchman.scrape",
+    "watchman.snapshot",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No fault armed in one test may leak into the next (or into the
+    rest of the tier-1 run)."""
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+@pytest.fixture(scope="module")
+def bankable_models():
+    rng = np.random.RandomState(0)
+    X = rng.rand(160, 3).astype("float32")
+    models = {}
+    for i, name in enumerate(("chaos-a", "chaos-b")):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(epochs=1, batch_size=64)
+        )
+        det.fit(X + 0.01 * i)
+        models[name] = det
+    return models
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory, bankable_models):
+    root = tmp_path_factory.mktemp("chaos-collection")
+    for name, det in bankable_models.items():
+        serializer.dump(det, str(root / name), metadata={"name": name})
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def poisoned_artifact_dir(tmp_path_factory, bankable_models):
+    """One healthy artifact + one whose trained params are all-NaN (the
+    "bucket program emits NaN" scenario: extraction and banking succeed,
+    every score comes out non-finite)."""
+    import copy
+
+    import jax
+
+    root = tmp_path_factory.mktemp("chaos-poisoned")
+    healthy = bankable_models["chaos-a"]
+    serializer.dump(healthy, str(root / "ok"), metadata={"name": "ok"})
+    poisoned = copy.deepcopy(bankable_models["chaos-b"])
+    est = poisoned.base_estimator
+    est.params_ = jax.tree.map(
+        lambda a: np.full_like(np.asarray(a), np.nan), est.params_
+    )
+    serializer.dump(poisoned, str(root / "nan-model"), metadata={"name": "nan-model"})
+    return str(root)
+
+
+@contextlib.asynccontextmanager
+async def _client(artifact_dir, **kwargs):
+    kwargs.setdefault("devices", 1)  # single-device: chaos, not sharding
+    client = TestClient(TestServer(build_app(artifact_dir, **kwargs)))
+    await client.start_server()
+    try:
+        yield client
+    finally:
+        await client.close()
+
+
+def _x_payload(rows=24, cols=3):
+    rng = np.random.RandomState(7)
+    return {"X": rng.rand(rows, cols).tolist()}
+
+
+async def _healthz(client):
+    resp = await client.get("/gordo/v0/proj/healthz")
+    return resp.status, await resp.json()
+
+
+# ------------------------------------------------------------------ #
+# registry mechanics
+# ------------------------------------------------------------------ #
+
+
+def test_every_failure_site_is_registered():
+    # importing the subsystems registers their sites at module import
+    import gordo_components_tpu.builder.fleet_build  # noqa: F401
+    import gordo_components_tpu.parallel.checkpoint  # noqa: F401
+    import gordo_components_tpu.server.bank  # noqa: F401
+    import gordo_components_tpu.server.model_io  # noqa: F401
+    import gordo_components_tpu.watchman.server  # noqa: F401
+
+    assert EXPECTED_SITES <= set(resilience.registered_sites())
+
+
+def test_raise_n_times_then_passes():
+    point = resilience.faultpoint("chaos.test.n")
+    resilience.arm("chaos.test.n", times=2, exc=OSError)
+    with pytest.raises(OSError):
+        point.fire()
+    with pytest.raises(OSError):
+        point.fire()
+    point.fire()  # exhausted: passes
+    assert resilience.fault_stats()["chaos.test.n"]["fired"] == 2
+
+
+def test_probabilistic_raise_is_seed_deterministic():
+    def decisions(seed):
+        spec = FaultSpec(p=0.5, seed=seed, exc=FaultInjected)
+        out = []
+        for _ in range(32):
+            try:
+                spec.fire("chaos.test.p")
+                out.append(False)
+            except FaultInjected:
+                out.append(True)
+        return out
+
+    assert decisions(7) == decisions(7)  # replayable chaos
+    assert decisions(7) != decisions(8)  # and actually seed-driven
+    assert any(decisions(7)) and not all(decisions(7))
+
+
+def test_latency_injection_delays_without_raising():
+    point = resilience.faultpoint("chaos.test.latency")
+    resilience.arm("chaos.test.latency", delay_s=0.03, exc=None)
+    t0 = time.perf_counter()
+    point.fire()
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_context_and_decorator_forms():
+    point = resilience.faultpoint("chaos.test.forms")
+    resilience.arm("chaos.test.forms", times=2)
+    with pytest.raises(FaultInjected):
+        with point:
+            pass
+
+    @point
+    def work():
+        return "done"
+
+    with pytest.raises(FaultInjected):
+        work()
+    assert work() == "done"  # exhausted
+
+
+def test_env_grammar_and_pre_registration():
+    n = resilience.configure_from_env(
+        "chaos.test.env=error:OSError,times=3;chaos.test.lat=latency:0.001"
+    )
+    assert n == 2
+    stats = resilience.fault_stats()
+    assert stats["chaos.test.env"]["exception"] == "OSError"
+    assert stats["chaos.test.env"]["times"] == 3
+    assert stats["chaos.test.lat"]["delay_s"] == 0.001
+    # arming precedes site registration: the parked spec attaches when
+    # the owning module declares the point
+    resilience.arm("chaos.test.notyet", times=1)
+    point = resilience.faultpoint("chaos.test.notyet")
+    with pytest.raises(FaultInjected):
+        point.fire()
+    with pytest.raises(ValueError):
+        resilience.configure_from_env("chaos.test.bad=explode")
+    with pytest.raises(ValueError):
+        resilience.configure_from_env("chaos.test.bad=error:os.system")
+
+
+def test_quarantine_set_unit():
+    from gordo_components_tpu.resilience import QuarantineSet
+
+    q = QuarantineSet(threshold=2)
+    assert not q.record_failure("m", "boom 1")
+    q.record_success("m")  # success resets the streak
+    assert not q.record_failure("m", "boom 2")
+    assert q.record_failure("m", "boom 3")  # 2 consecutive -> quarantined
+    assert "m" in q and len(q) == 1
+    assert q.reason("m")["reason"] == "boom 3"
+    assert q.clear(["m"]) == ["m"]
+    assert "m" not in q
+    disabled = QuarantineSet(threshold=0)
+    for _ in range(10):
+        disabled.record_failure("m", "x")
+    assert "m" not in disabled
+
+
+# ------------------------------------------------------------------ #
+# serving: artifact load, bucket finalize, scoring, engine queue
+# ------------------------------------------------------------------ #
+
+
+async def test_artifact_load_fault_serves_healthy_subset_and_recovers(
+    artifact_dir,
+):
+    resilience.arm("model_io.load", times=1, exc=OSError)
+    async with _client(artifact_dir) as client:
+        status, body = await _healthz(client)
+        assert status == 200
+        assert body["status"] == "degraded"  # never a silent ok
+        assert len(body["load_failures"]) == 1
+        assert body["models"] == 1
+        # the healthy model keeps serving
+        survivor = "chaos-" + ("b" if "chaos-a" in body["load_failures"] else "a")
+        resp = await client.post(
+            f"/gordo/v0/proj/{survivor}/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+        # the fallback is visible to operators: /stats and the counter
+        stats = await (await client.get("/gordo/v0/proj/stats")).json()
+        assert stats["load_failures"]["total"] >= 1
+        assert stats["load_failures"]["current"]
+        metrics = await (await client.get("/gordo/v0/proj/metrics")).text()
+        assert "gordo_models_load_failed_total 1" in metrics
+        # fault exhausted: /reload retries the failed artifact and clears
+        # the degradation
+        resp = await client.post("/gordo/v0/proj/reload")
+        assert resp.status == 200
+        status, body = await _healthz(client)
+        assert status == 200 and body["status"] == "ok"
+        assert body["models"] == 2
+
+
+async def test_bucket_finalize_fault_falls_back_to_per_model_path(
+    artifact_dir,
+):
+    resilience.arm("bank.finalize", times=1)
+    async with _client(artifact_dir) as client:
+        status, body = await _healthz(client)
+        assert status == 200 and body["status"] == "degraded"
+        assert body["bank_finalize_failures"]
+        # both models still answer — through the per-model path
+        models = await (await client.get("/gordo/v0/proj/models")).json()
+        assert models["bank"]["banked"] == []
+        assert all(
+            "bucket finalize failed" in reason
+            for reason in models["bank"]["fallback"].values()
+        )
+        for name in ("chaos-a", "chaos-b"):
+            resp = await client.post(
+                f"/gordo/v0/proj/{name}/anomaly/prediction", json=_x_payload()
+            )
+            assert resp.status == 200
+
+
+async def test_scoring_fault_quarantines_and_410s(artifact_dir):
+    async with _client(artifact_dir, quarantine_threshold=3) as client:
+        resilience.arm("bank.score", exc=FaultInjected)
+        for i in range(3):
+            resp = await client.post(
+                "/gordo/v0/proj/chaos-a/prediction", json=_x_payload()
+            )
+            assert resp.status == 400, f"failure {i} must surface, not crash"
+        # breaker tripped: 410 with the recorded reason, no more scoring
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-a/prediction", json=_x_payload()
+        )
+        assert resp.status == 410
+        body = await resp.json()
+        assert "quarantined" in body["error"]
+        assert "FaultInjected" in body["reason"]
+        status, health = await _healthz(client)
+        assert status == 200 and health["status"] == "degraded"
+        assert "chaos-a" in health["quarantined"]
+        # surfaced in /stats, the gauge, and the quarantine endpoint
+        stats = await (await client.get("/gordo/v0/proj/stats")).json()
+        assert "chaos-a" in stats["quarantine"]["quarantined"]
+        metrics = await (await client.get("/gordo/v0/proj/metrics")).text()
+        assert "gordo_quarantined_models 1" in metrics
+        listing = await (await client.get("/gordo/v0/proj/quarantine")).json()
+        assert "chaos-a" in listing["quarantined"]
+        # the OTHER model never stopped serving
+        resilience.reset()
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-b/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+        # operator clears the quarantine -> healthy again
+        resp = await client.post(
+            "/gordo/v0/proj/quarantine/clear", json={"targets": ["chaos-a"]}
+        )
+        assert (await resp.json())["cleared"] == ["chaos-a"]
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-a/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+        status, health = await _healthz(client)
+        assert health["status"] == "ok"
+
+
+async def test_nonfinite_scores_quarantine_poisoned_model(
+    poisoned_artifact_dir,
+):
+    async with _client(poisoned_artifact_dir, quarantine_threshold=2) as client:
+        for _ in range(2):
+            resp = await client.post(
+                "/gordo/v0/proj/nan-model/anomaly/prediction", json=_x_payload()
+            )
+            # NaN scores still return (degradation is gradual), but count
+            assert resp.status == 200
+        resp = await client.post(
+            "/gordo/v0/proj/nan-model/anomaly/prediction", json=_x_payload()
+        )
+        assert resp.status == 410
+        body = await resp.json()
+        assert "non-finite" in body["reason"]
+        status, health = await _healthz(client)
+        assert status == 200 and health["status"] == "degraded"
+        # the healthy model is unaffected
+        resp = await client.post(
+            "/gordo/v0/proj/ok/anomaly/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+
+
+async def test_nonfinite_input_does_not_quarantine(artifact_dir):
+    """A client POSTing NaN rows gets NaN scores back — that is the
+    client's data, and must never evict a healthy model."""
+    async with _client(artifact_dir, quarantine_threshold=1) as client:
+        payload = {"X": [[float("nan")] * 3] * 24}
+        for _ in range(2):
+            resp = await client.post(
+                "/gordo/v0/proj/chaos-a/prediction", json=payload
+            )
+            assert resp.status == 200
+        status, health = await _healthz(client)
+        assert health["status"] == "ok"
+        assert health["quarantined"] == {}
+
+
+async def test_engine_queue_fault_degrades_and_recovers(artifact_dir):
+    async with _client(artifact_dir, quarantine_threshold=3) as client:
+        resilience.arm("engine.queue", exc=FaultInjected)
+        for _ in range(3):
+            resp = await client.post(
+                "/gordo/v0/proj/chaos-b/prediction", json=_x_payload()
+            )
+            assert resp.status == 400
+        status, health = await _healthz(client)
+        assert health["status"] == "degraded"
+        resilience.reset()
+        await client.post(
+            "/gordo/v0/proj/quarantine/clear", json={}
+        )
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-b/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+
+
+async def test_engine_queue_latency_injection_slows_but_serves(artifact_dir):
+    async with _client(artifact_dir) as client:
+        spec = resilience.arm("engine.queue", delay_s=0.02, exc=None)
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-a/prediction", json=_x_payload()
+        )
+        assert resp.status == 200
+        assert spec.fired >= 1
+
+
+# ------------------------------------------------------------------ #
+# watchman: scrape misses and snapshot refresh failures
+# ------------------------------------------------------------------ #
+
+
+async def test_watchman_scrape_fault_keeps_last_good_rollup(
+    artifact_dir, live_server
+):
+    from gordo_components_tpu.watchman.server import (
+        WatchmanState,
+        render_fleet_metrics,
+    )
+
+    async with live_server(artifact_dir) as base_url:
+        state = WatchmanState(
+            "proj", base_url, refresh_interval=0.0,
+            metrics_urls=[f"{base_url}/gordo/v0/proj/metrics"],
+        )
+        agg1 = await state.fleet_metrics()
+        assert agg1["replicas_scraped"] == 1
+        assert agg1["sums"]
+        resilience.arm("watchman.scrape", exc=FaultInjected)
+        await asyncio.sleep(0.05)
+        agg2 = await state.fleet_metrics()
+        # the replica dropped out of the live count but its last-good
+        # numbers stay in the rollup, stamped stale instead of vanishing
+        assert agg2["replicas_scraped"] == 0
+        assert agg2["sums"] == agg1["sums"]
+        text = render_fleet_metrics(agg2)
+        assert 'gordo_fleet_scrape_stale_seconds{replica="0"}' in text
+        for line in text.splitlines():
+            if line.startswith('gordo_fleet_scrape_stale_seconds{replica="0"}'):
+                assert float(line.rsplit(" ", 1)[1]) >= 0.05
+
+
+async def test_watchman_http_rollup_survives_total_scrape_loss(
+    artifact_dir, live_server
+):
+    from gordo_components_tpu.watchman.server import build_watchman_app
+
+    async with live_server(artifact_dir) as base_url:
+        app = build_watchman_app(
+            "proj", base_url, refresh_interval=0.0,
+            metrics_urls=[f"{base_url}/gordo/v0/proj/metrics"],
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert "gordo_fleet_replicas_scraped 1" in await resp.text()
+            resilience.arm("watchman.scrape", exc=FaultInjected)
+            # cache is stale (interval 0): the endpoint serves the cached
+            # rollup and refreshes in the background; poll until the
+            # failed refresh lands
+            for _ in range(50):
+                resp = await client.get("/metrics")
+                assert resp.status == 200  # never an error
+                text = await resp.text()
+                if "gordo_fleet_replicas_scraped 0" in text:
+                    break
+                await asyncio.sleep(0.02)
+            assert "gordo_fleet_replicas_scraped 0" in text
+            # last-good server series still present, stale stamped
+            assert "gordo_server_uptime_seconds" in text
+            assert "gordo_fleet_scrape_stale_seconds" in text
+        finally:
+            await client.close()
+
+
+async def test_watchman_snapshot_fault_serves_stale_stamped_snapshot(
+    artifact_dir, live_server
+):
+    from gordo_components_tpu.watchman.server import build_watchman_app
+
+    async with live_server(artifact_dir) as base_url:
+        app = build_watchman_app("proj", base_url, refresh_interval=0.0)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body1 = await (await client.get("/")).json()
+            assert len(body1["endpoints"]) == 2
+            assert "stale" not in body1
+            resilience.arm("watchman.snapshot", exc=FaultInjected)
+            resp = await client.get("/")
+            assert resp.status == 200  # degraded, not dead
+            body2 = await resp.json()
+            assert body2["stale"] is True
+            assert body2["stale_seconds"] >= 0
+            assert "FaultInjected" in body2["refresh_error"]
+            assert body2["endpoints"] == body1["endpoints"]
+            resilience.reset()
+            body3 = await (await client.get("/")).json()
+            assert "stale" not in body3
+        finally:
+            await client.close()
+
+
+# ------------------------------------------------------------------ #
+# fleet build: per-group isolation + partial manifest (slow lane)
+# ------------------------------------------------------------------ #
+
+_DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00Z",
+    "train_end_date": "2020-01-01T06:00:00Z",
+    "tag_list": ["a", "b"],
+}
+
+
+def _model_cfg(dims):
+    return {
+        "gordo_components_tpu.models.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "sklearn.pipeline.Pipeline": {
+                    "steps": [
+                        "sklearn.preprocessing.MinMaxScaler",
+                        {
+                            "gordo_components_tpu.models.AutoEncoder": {
+                                "kind": "feedforward_symmetric",
+                                "dims": dims,
+                                "epochs": 1,
+                                "batch_size": 32,
+                            }
+                        },
+                    ]
+                }
+            }
+        }
+    }
+
+
+def _machines():
+    from gordo_components_tpu.workflow.config import Machine
+
+    # two distinct hparam groups: [m1, m2] share one gang, m3 is its own
+    return [
+        Machine(name="m1", dataset=dict(_DATASET), model=_model_cfg([4])),
+        Machine(name="m2", dataset=dict(_DATASET), model=_model_cfg([4])),
+        Machine(name="m3", dataset=dict(_DATASET), model=_model_cfg([2])),
+    ]
+
+
+@pytest.mark.slow
+def test_poisoned_group_yields_partial_build(tmp_path):
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+    from gordo_components_tpu.workflow.gang_state import read_gang_states
+
+    # first group fails BOTH attempts (1 retry); second group untouched
+    resilience.arm("fleet_build.group", times=2, exc=FaultInjected)
+    state_dir = tmp_path / "state"
+    report = build_fleet(
+        _machines(), str(tmp_path / "out"), state_dir=str(state_dir),
+        gang_id="g-partial",
+    )
+    assert sorted(report.failed) == ["m1", "m2"]
+    assert sorted(report) == ["m3"]
+    assert os.path.exists(tmp_path / "out" / "m3" / "model.pkl")
+    manifest = report.manifest()
+    assert manifest["n_built"] == 1 and manifest["n_failed"] == 2
+    assert "FaultInjected" in manifest["failed"]["m1"]
+    # heartbeat: terminal 'partial', never 'stale'
+    (s,) = read_gang_states(str(state_dir), stale_after=0.0)
+    assert s["phase"] == "partial"
+    assert s["failed_members"] == 2
+    assert not s["stale"]
+
+
+@pytest.mark.slow
+def test_transient_group_fault_retried_to_full_build(tmp_path):
+    from gordo_components_tpu.builder.fleet_build import build_fleet
+
+    resilience.arm("fleet_build.group", times=1, exc=FaultInjected)
+    report = build_fleet(_machines(), str(tmp_path / "out"))
+    assert not report.failed
+    assert sorted(report) == ["m1", "m2", "m3"]
+    assert report.group_retries == 1
+
+
+@pytest.mark.slow
+def test_cli_partial_build_exit_code_and_manifest(tmp_path):
+    from click.testing import CliRunner
+
+    from gordo_components_tpu.cli.cli import (
+        EXIT_BUILD_ERROR,
+        EXIT_PARTIAL_BUILD,
+        gordo,
+    )
+
+    payload = {
+        "machines": [
+            {"name": "m1", "dataset": _DATASET, "model": _model_cfg([4])},
+            {"name": "m3", "dataset": _DATASET, "model": _model_cfg([2])},
+        ]
+    }
+    machines_file = tmp_path / "machines.json"
+    machines_file.write_text(json.dumps(payload))
+    runner = CliRunner()
+    out_dir = tmp_path / "out"
+    result = runner.invoke(
+        gordo,
+        ["build-fleet", "--machines-file", str(machines_file),
+         "--output-dir", str(out_dir)],
+        env={"GORDO_FAULTS": "fleet_build.group=error,times=2"},
+    )
+    assert result.exit_code == EXIT_PARTIAL_BUILD, result.output
+    manifest = json.loads(
+        (out_dir / "build_manifest.json").read_text()
+    )
+    assert manifest["schema"] == "gordo.fleet-build.manifest/v1"
+    assert sorted(manifest["failed"]) == ["m1"]
+    assert sorted(manifest["built"]) == ["m3"]
+    resilience.reset()
+
+    # everything-failed is a DIFFERENT exit code than partial
+    result = runner.invoke(
+        gordo,
+        ["build-fleet", "--machines-file", str(machines_file),
+         "--output-dir", str(tmp_path / "out2")],
+        env={"GORDO_FAULTS": "fleet_build.group=error,times=4"},
+    )
+    assert result.exit_code == EXIT_BUILD_ERROR, result.output
+
+
+# ------------------------------------------------------------------ #
+# checkpoint IO faults
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.slow
+def test_checkpoint_write_fault_does_not_kill_training(tmp_path):
+    from gordo_components_tpu.parallel.fleet import FleetTrainer
+
+    resilience.arm("checkpoint.write", exc=OSError)
+    rng = np.random.RandomState(0)
+    members = {f"m-{i}": rng.rand(64, 3).astype("float32") for i in range(4)}
+    trainer = FleetTrainer(
+        kind="feedforward_hourglass", epochs=3, batch_size=32, seed=1,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+    )
+    models = trainer.fit(members)  # must complete, checkpoints sacrificed
+    assert sorted(models) == sorted(members)
+    assert resilience.fault_stats()["checkpoint.write"]["fired"] >= 1
+
+
+def test_checkpoint_read_fault_falls_back_to_fresh_start(tmp_path):
+    from gordo_components_tpu.parallel.checkpoint import FleetBucketCheckpoint
+
+    ck = FleetBucketCheckpoint(str(tmp_path), "a" * 24)
+    state = {"w": np.arange(6, dtype=np.float32)}
+    ck.save(0, state, {"note": "x"})
+    resilience.arm("checkpoint.read", times=1, exc=OSError)
+    assert ck.restore() is None  # unreadable -> fresh start, no crash
+    restored = ck.restore()  # fault exhausted: reads fine again
+    np.testing.assert_array_equal(restored["state"]["w"], state["w"])
+
+
+# ------------------------------------------------------------------ #
+# hot-path overhead guard (PR-1 pattern): disabled faultpoints must not
+# cost the serving loop anything measurable
+# ------------------------------------------------------------------ #
+
+
+def test_disabled_faultpoints_within_5pct(bankable_models, monkeypatch):
+    """``score_many`` with the real (disarmed) faultpoint vs a no-op stub
+    in its place must be within 5% — catches accidental work creeping
+    into the disabled ``fire()`` path (env reads, locks, allocation).
+    Interleaved best-of-N timing so machine drift hits both sides."""
+    from gordo_components_tpu.server import bank as bank_mod
+    from gordo_components_tpu.server.bank import ModelBank
+
+    rng = np.random.RandomState(2)
+    bank = ModelBank.from_models(bankable_models, registry=False)
+    requests = [
+        (name, rng.rand(64, 3).astype("float32"), None)
+        for name in bankable_models
+    ]
+    bank.score_many(requests)  # warm/compile
+
+    class _NullPoint:
+        def fire(self):
+            pass
+
+    real_point = bank_mod._FP_SCORE
+    assert real_point._spec is None  # disarmed: the config under test
+
+    def timed(iters=40):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bank.score_many(requests)
+        return time.perf_counter() - t0
+
+    rounds, ratios = 7, []
+    for _ in range(rounds):
+        monkeypatch.setattr(bank_mod, "_FP_SCORE", _NullPoint())
+        control = timed()
+        monkeypatch.setattr(bank_mod, "_FP_SCORE", real_point)
+        instrumented = timed()
+        ratios.append(instrumented / control)
+    assert min(ratios) <= 1.05, ratios

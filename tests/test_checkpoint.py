@@ -332,3 +332,88 @@ class TestAsyncProtocol:
         ck.clear()
         assert not os.path.isdir(ck.root)
         assert ck.restore() is None
+
+
+class TestReadValidation:
+    """Digest validation on RESTORE (the write side was always atomic;
+    the read side used to trust the payload): a checkpoint whose state
+    bytes changed on disk must be rejected and the most recent VALID
+    checkpoint (or a fresh start) used instead."""
+
+    def _state(self, k=0.0):
+        return {
+            "w": np.arange(100, dtype=np.float32) + k,
+            "b": np.ones((4,), np.float32) * k,
+        }
+
+    def test_digest_written_and_round_trips(self, tmp_path):
+        import json
+
+        ck = FleetBucketCheckpoint(str(tmp_path), "f" * 24)
+        ck.save(3, self._state(1.0), {"histories": [[0.5]]})
+        with open(os.path.join(ck.root, "3", "host.json")) as f:
+            host = json.load(f)
+        assert len(host["state_digest"]) == 64  # sha256 hex
+        resumed = ck.restore()
+        assert resumed is not None and resumed["epoch"] == 3
+        np.testing.assert_array_equal(resumed["state"]["w"], self._state(1.0)["w"])
+        # the digest is consumed by validation, not leaked to the trainer
+        assert "state_digest" not in resumed
+
+    def test_tampered_digest_falls_back_to_older_valid_epoch(self, tmp_path):
+        import json
+        import shutil
+
+        ck = FleetBucketCheckpoint(str(tmp_path), "a" * 24)
+        ck.save(1, self._state(1.0), {"histories": []})
+        # forge a NEWER committed epoch whose recorded digest does not
+        # match its (otherwise perfectly readable) state payload
+        shutil.copytree(
+            os.path.join(ck.root, "1"), os.path.join(ck.root, "2")
+        )
+        host_path = os.path.join(ck.root, "2", "host.json")
+        with open(host_path) as f:
+            host = json.load(f)
+        host["state_digest"] = "0" * 64
+        with open(host_path, "w") as f:
+            json.dump(host, f)
+        resumed = ck.restore()
+        # the corrupt newest epoch is skipped; the older valid one resumes
+        assert resumed is not None and resumed["epoch"] == 1
+        np.testing.assert_array_equal(resumed["state"]["w"], self._state(1.0)["w"])
+
+    def test_corrupted_state_bytes_rejected(self, tmp_path):
+        ck = FleetBucketCheckpoint(str(tmp_path), "b" * 24)
+        ck.save(0, self._state(2.0), {"histories": []})
+        # flip bytes in the largest state payload file (where the array
+        # data lives); whether orbax's own integrity checks or our digest
+        # catches it, restore must fall back to a fresh start, not crash
+        # and not resume into garbage
+        state_dir = os.path.join(ck.root, "0", "state")
+        paths = [
+            os.path.join(root, f)
+            for root, _dirs, files in os.walk(state_dir)
+            for f in files
+        ]
+        victim = max(paths, key=os.path.getsize)
+        data = bytearray(open(victim, "rb").read())
+        mid = len(data) // 2
+        for i in range(mid, min(mid + 16, len(data))):
+            data[i] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(bytes(data))
+        assert ck.restore() is None
+
+    def test_legacy_checkpoint_without_digest_still_restores(self, tmp_path):
+        import json
+
+        ck = FleetBucketCheckpoint(str(tmp_path), "c" * 24)
+        ck.save(0, self._state(), {"histories": []})
+        host_path = os.path.join(ck.root, "0", "host.json")
+        with open(host_path) as f:
+            host = json.load(f)
+        host.pop("state_digest")
+        with open(host_path, "w") as f:
+            json.dump(host, f)
+        resumed = ck.restore()
+        assert resumed is not None and resumed["epoch"] == 0

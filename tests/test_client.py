@@ -340,3 +340,77 @@ async def test_client_small_explicit_target_list_skips_prefetch():
     assert results[0].ok, results[0].error_messages
     assert counts["metadata_all"] == 0
     assert counts["metadata"] == 1
+
+
+class TestRetryAfterParsing:
+    """Both RFC 9110 Retry-After forms must parse: delta-seconds (our own
+    shedding server) AND HTTP-date (proxies and foreign peers) — the date
+    form used to be silently dropped, keeping the computed backoff."""
+
+    def test_delta_seconds(self):
+        from gordo_components_tpu.client.io import retry_after_seconds
+
+        assert retry_after_seconds("17") == 17.0
+        assert retry_after_seconds(" 2.5 ") == 2.5
+        assert retry_after_seconds("0") == 0.0
+
+    def test_http_date(self):
+        from datetime import datetime, timedelta, timezone
+        from email.utils import format_datetime
+
+        from gordo_components_tpu.client.io import retry_after_seconds
+
+        future = datetime.now(timezone.utc) + timedelta(seconds=30)
+        got = retry_after_seconds(format_datetime(future, usegmt=True))
+        assert got is not None and 25.0 <= got <= 30.5
+        # a date in the past clamps to "retry now", never negative
+        past = datetime.now(timezone.utc) - timedelta(seconds=300)
+        assert retry_after_seconds(format_datetime(past, usegmt=True)) == 0.0
+
+    def test_garbage_returns_none(self):
+        from gordo_components_tpu.client.io import retry_after_seconds
+
+        assert retry_after_seconds("soon-ish") is None
+        assert retry_after_seconds("") is None
+
+
+async def test_fetch_json_honors_http_date_retry_after():
+    """A 503 carrying an HTTP-date Retry-After must delay the retry by
+    (roughly) the hinted window, not the default 0.01s backoff."""
+    import time as _time
+    from datetime import datetime, timedelta, timezone
+    from email.utils import format_datetime
+
+    import aiohttp
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    from gordo_components_tpu.client.io import fetch_json
+
+    calls = []
+
+    async def handler(request):
+        calls.append(_time.monotonic())
+        if len(calls) == 1:
+            when = datetime.now(timezone.utc) + timedelta(seconds=1)
+            raise web.HTTPServiceUnavailable(
+                headers={"Retry-After": format_datetime(when, usegmt=True)}
+            )
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        async with aiohttp.ClientSession() as session:
+            body = await fetch_json(
+                session, f"http://{server.host}:{server.port}/x",
+                retries=2, backoff=0.01,
+            )
+    finally:
+        await server.close()
+    assert body == {"ok": True}
+    assert len(calls) == 2
+    # the retry waited for the date hint (>=~1s), not the 0.01s backoff
+    assert calls[1] - calls[0] >= 0.8

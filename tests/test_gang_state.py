@@ -128,3 +128,69 @@ async def test_watchman_serves_gang_states(tmp_path):
         assert body["gangs"][0]["epoch"] == 7
     finally:
         await client.close()
+
+
+def test_gang_that_stops_heartbeating_goes_stale(tmp_path):
+    """The real failure mode: a gang that heartbeated normally and then
+    froze (OOM-killed trainer, wedged device) must become ``stale`` purely
+    by the passage of time — reportable, not ``running`` forever."""
+    hb = GangHeartbeat(str(tmp_path), gang_id="frozen")
+    hb.update(phase="training", epoch=4)
+    (s,) = read_gang_states(str(tmp_path), stale_after=30.0)
+    assert not s["stale"]  # fresh while it keeps writing
+    time.sleep(0.15)
+    (s,) = read_gang_states(str(tmp_path), stale_after=0.1)
+    assert s["stale"]
+    assert s["phase"] == "training"  # the phase it froze in stays visible
+    assert s["age_seconds"] >= 0.1
+    # one more write revives it
+    hb.update(phase="training", epoch=5)
+    (s,) = read_gang_states(str(tmp_path), stale_after=0.1)
+    assert not s["stale"]
+
+
+def test_partial_phase_is_terminal_never_stale(tmp_path):
+    """A partial build (some groups failed, manifest shipped —
+    builder/fleet_build.py) is FINISHED: however old its heartbeat, it
+    must not page as a hung gang."""
+    hb = GangHeartbeat(str(tmp_path), gang_id="p")
+    hb.finish("partial", built=3, failed_members=2)
+    with open(hb.path) as f:
+        state = json.load(f)
+    state["ts"] = time.time() - 3600
+    with open(hb.path, "w") as f:
+        json.dump(state, f)
+    (s,) = read_gang_states(str(tmp_path), stale_after=1.0)
+    assert not s["stale"]
+    assert s["phase"] == "partial"
+    assert s["failed_members"] == 2
+
+
+async def test_watchman_reports_stalled_gang(tmp_path):
+    """The operator-facing path: a mid-training gang whose heartbeat
+    stopped shows ``stale: true`` in the watchman snapshot."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu.watchman.server import build_watchman_app
+
+    hb = GangHeartbeat(str(tmp_path), gang_id="hung-gang")
+    hb.update(phase="training", epoch=2)
+    with open(hb.path) as f:
+        state = json.load(f)
+    state["ts"] = time.time() - 600
+    with open(hb.path, "w") as f:
+        json.dump(state, f)
+    app = build_watchman_app(
+        "proj", "http://127.0.0.1:1", targets=[],
+        gang_state_dir=str(tmp_path),
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        body = await (await client.get("/")).json()
+        (gang,) = body["gangs"]
+        assert gang["gang_id"] == "hung-gang"
+        assert gang["stale"] is True
+        assert gang["phase"] == "training"
+    finally:
+        await client.close()
