@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos hotloop trace-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -22,6 +22,18 @@ test-fast:
 # (tests/test_chaos.py; the standing regression harness for robustness)
 chaos:
 	$(PYTHON) -m pytest tests/ -q -m chaos
+
+# hot-loop overhead lane: every disabled-instrumentation guard in one
+# named check (metrics recording, disarmed faultpoints, tracing) — a
+# regression that makes "off" cost >5% on the serving loop fails HERE,
+# not buried in the full run
+hotloop:
+	$(PYTHON) -m pytest tests/ -q -m hotloop
+
+# short serve loop with tracing at sample=1.0; prints the top-3 slow
+# traces with their per-stage breakdown (tools/trace_demo.py)
+trace-demo:
+	$(PYTHON) tools/trace_demo.py
 
 bench:
 	$(PYTHON) bench.py

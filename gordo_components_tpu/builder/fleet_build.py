@@ -10,6 +10,7 @@ gang.
 """
 
 import copy
+import json
 import logging
 import os
 import time
@@ -33,6 +34,11 @@ from gordo_components_tpu.parallel.fleet import (
     _target_offset_for,
 )
 from gordo_components_tpu.observability import get_registry
+from gordo_components_tpu.observability.tracing import (
+    chrome_trace,
+    get_tracer,
+    use_trace,
+)
 from gordo_components_tpu.resilience.faults import faultpoint
 from gordo_components_tpu.utils import metadata_timestamp
 from gordo_components_tpu.utils.staging import stage_members
@@ -69,6 +75,28 @@ class FleetBuildReport(Dict[str, str]):
             "n_failed": len(self.failed),
             "group_retries": self.group_retries,
         }
+
+
+def _finish_build_trace(trace, output_dir: str, **attrs: Any) -> None:
+    """Close the build trace and persist it as Chrome trace-event JSON
+    next to the build manifest — best-effort (the trace is diagnostics,
+    never worth failing a build over), and written on the crash path too:
+    a flight recorder is most valuable for the build that died."""
+    if trace is None:
+        return
+    trace.finish(**attrs)
+    try:
+        os.makedirs(output_dir, exist_ok=True)
+        path = os.path.join(output_dir, "build_trace.json")
+        with open(path, "w") as f:
+            json.dump(chrome_trace([trace]), f)
+        logger.info(
+            "build trace (fit/compile/checkpoint spans per bucket) -> %s "
+            "(trace_id=%s; open in chrome://tracing or Perfetto)",
+            path, trace.trace_id,
+        )
+    except Exception:
+        logger.warning("failed to write build trace", exc_info=True)
 
 
 def _build_counters():
@@ -411,6 +439,13 @@ def build_fleet(
     configure_from_env()  # GORDO_FAULTS: chaos runs drive the build path too
     if group_retries is None:
         group_retries = int(os.environ.get("GORDO_BUILD_GROUP_RETRIES", "1"))
+    # one build trace per build_fleet run (observability/tracing.py):
+    # fleet groups record per-bucket fit/compile/checkpoint spans into it
+    # and the Chrome trace-event export lands next to the build manifest.
+    # force=True: a build is one trace, not head-sampled traffic —
+    # GORDO_TRACE_SAMPLE=0 still disables tracing entirely
+    tracer = get_tracer()
+    trace = tracer.start_trace("fleet_build", force=True)
     results = FleetBuildReport()
     fleet_groups: Dict[Tuple, List[Tuple[Machine, Dict[str, Any]]]] = {}
     trainer_mesh = None
@@ -542,12 +577,18 @@ def build_fleet(
             # still ship their artifacts
             for attempt in range(group_retries + 1):
                 try:
-                    _build_fleet_group(
-                        group, output_dir, model_register_dir, replace_cache,
-                        results, checkpoint_dir=checkpoint_dir,
-                        checkpoint_every=checkpoint_every, mesh=trainer_mesh,
-                        heartbeat=heartbeat, counters=counters,
-                    )
+                    # use_trace: the fleet trainer's bucket loop reads the
+                    # current trace from the contextvar (parallel/fleet.py)
+                    # instead of threading a parameter six layers down
+                    with use_trace(trace):
+                        _build_fleet_group(
+                            group, output_dir, model_register_dir,
+                            replace_cache, results,
+                            checkpoint_dir=checkpoint_dir,
+                            checkpoint_every=checkpoint_every,
+                            mesh=trainer_mesh,
+                            heartbeat=heartbeat, counters=counters,
+                        )
                     break
                 except Exception as exc:
                     if attempt < group_retries:
@@ -577,7 +618,12 @@ def build_fleet(
             heartbeat.finish(
                 "failed", built=len(results), error=f"{type(exc).__name__}: {exc}"
             )
+        _finish_build_trace(trace, output_dir, error=True)
         raise
+    _finish_build_trace(
+        trace, output_dir,
+        n_built=len(results), n_failed=len(results.failed),
+    )
     if heartbeat is not None:
         if not results.failed:
             heartbeat.finish("done", built=len(results))

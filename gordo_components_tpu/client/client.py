@@ -9,6 +9,7 @@ prediction store.
 """
 
 import asyncio
+import hashlib
 import itertools
 import logging
 import uuid
@@ -19,6 +20,7 @@ import aiohttp
 import pandas as pd
 
 from gordo_components_tpu.client.io import fetch_json, fetch_metadata_all
+from gordo_components_tpu.observability.tracing import format_traceparent
 from gordo_components_tpu.dataset import get_dataset
 from gordo_components_tpu.server.utils import dict_to_frame
 from gordo_components_tpu.utils import parquet_engine_available
@@ -92,6 +94,21 @@ class Client:
 
     def _next_request_id(self) -> str:
         return f"{self._rid_prefix}-{next(self._rid_seq):x}"
+
+    @staticmethod
+    def _trace_headers(rid: str) -> Dict[str, str]:
+        """Scoring-POST id headers: the gordo request id plus a W3C
+        ``traceparent`` whose trace id is DERIVED from the request id
+        (md5 — identity, not security), so a client log line and the
+        server-side trace are the same identifier family and either one
+        recovers the other. The sampled flag is set: a request the
+        client bothered to stamp is one the operator wants retrievable
+        at ``GET .../traces`` regardless of server head sampling."""
+        trace_id = hashlib.md5(rid.encode()).hexdigest()
+        return {
+            "X-Gordo-Request-Id": rid,
+            "traceparent": format_traceparent(trace_id, trace_id[:16]),
+        }
 
     # ------------------------------------------------------------------ #
 
@@ -229,7 +246,7 @@ class Client:
         frame.to_parquet(buf)
         headers = {"Content-Type": "application/x-parquet"}
         if request_id:
-            headers["X-Gordo-Request-Id"] = request_id
+            headers.update(self._trace_headers(request_id))
         return await fetch_json(
             session,
             self._url(target, endpoint),
@@ -294,7 +311,7 @@ class Client:
                         self._url(target, endpoint),
                         method="POST",
                         json_payload=payload,
-                        headers={"X-Gordo-Request-Id": rid},
+                        headers=self._trace_headers(rid),
                     )
                 except Exception as exc:
                     errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")

@@ -15,11 +15,31 @@ from gordo_components_tpu.observability.metrics import (
     parse_prometheus_text,
     render_samples,
 )
+from gordo_components_tpu.observability.tracing import (
+    Span,
+    Trace,
+    Tracer,
+    chrome_trace,
+    current_trace,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    use_trace,
+)
 
 __all__ = [
     "Histogram",
     "MetricsRegistry",
+    "Span",
+    "Trace",
+    "Tracer",
+    "chrome_trace",
+    "current_trace",
+    "format_traceparent",
     "get_registry",
+    "get_tracer",
     "parse_prometheus_text",
+    "parse_traceparent",
     "render_samples",
+    "use_trace",
 ]

@@ -102,6 +102,18 @@ class Histogram:
         """Upper edge of bin i (i < n_bins)."""
         return 10 ** (self._log_lo + i / self._bpd)
 
+    def bucket_le(self, value: float) -> float:
+        """Upper edge of the bucket ``record(value)`` lands in (``inf``
+        for the overflow bin) — the key an exemplar attaches to, matching
+        the ``le`` edges :meth:`buckets` exposes."""
+        if value <= self._lo:
+            return self._lo
+        idx = min(
+            self._n_bins,
+            1 + int((math.log10(value) - self._log_lo) * self._bpd),
+        )
+        return math.inf if idx >= self._n_bins else self._edge(idx)
+
     def percentile(self, q: float) -> float:
         """Upper edge of the bin containing the q-quantile observation
         (<= one bin width above the true value); 0.0 when empty.

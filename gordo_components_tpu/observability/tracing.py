@@ -1,0 +1,538 @@
+"""Dependency-free request tracing: spans, W3C context, Chrome export.
+
+The metrics registry (observability/metrics.py) answers "how is the
+fleet doing"; this module answers "where did THIS request's 200 ms go".
+A :class:`Tracer` produces request-scoped :class:`Trace` objects whose
+:class:`Span` records carry monotonic timestamps, so the serving hot
+path (`queue_wait` -> `coalesce` -> `pad` -> `device_execute` ->
+`postprocess`) and the builder (`fit`/`compile`/`checkpoint` per bucket)
+become a per-request timeline instead of one histogram bucket.
+
+Design rules, mirroring the metrics layer:
+
+- **Hot-path safe** — a disabled tracer (``GORDO_TRACE_SAMPLE=0``)
+  returns ``None`` from ``start_trace`` and every call site guards on
+  that one reference; recording a span is two ``time.monotonic()`` reads
+  and a ``list.append`` (atomic under the GIL, so spans may be appended
+  from the scoring executor thread while the event loop owns the trace).
+- **W3C context propagation** — ``traceparent`` headers
+  (``00-<32hex trace-id>-<16hex span-id>-<2hex flags>``) parse on the
+  way in and format on the way out, so the client -> server -> engine ->
+  device chain shares one trace id end to end. An upstream ``sampled``
+  flag (0x01) forces retention past head sampling: the caller asked to
+  see this one.
+- **Sampling** — ``GORDO_TRACE_SAMPLE`` (default 0.1) head-samples
+  which completed traces enter the recent ring; the slow reservoir
+  ALWAYS considers every completed trace, so the worst requests are
+  retrievable even at low sample rates (the whole point of a flight
+  recorder). ``<=0`` disables tracing entirely.
+- **Bounded memory** — completed traces land in a ring
+  (``GORDO_TRACE_RING``, default 128) plus a worst-N min-heap reservoir
+  (``GORDO_TRACE_SLOW_KEEP``, default 16); nothing grows with traffic.
+- **Chrome trace-event export** — ``chrome_trace(traces)`` emits the
+  Trace Event Format JSON (``ph: "X"`` complete events, microsecond
+  ``ts``/``dur``) that ``chrome://tracing`` and Perfetto open directly.
+
+Span names are a stability contract like metric names — see
+docs/observability.md ("Tracing").
+"""
+
+import contextlib
+import contextvars
+import heapq
+import itertools
+import os
+import random
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Span",
+    "Trace",
+    "Tracer",
+    "chrome_trace",
+    "current_trace",
+    "format_traceparent",
+    "get_tracer",
+    "parse_traceparent",
+    "use_trace",
+]
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str, bool]]:
+    """``(trace_id, parent_span_id, sampled)`` from a W3C ``traceparent``
+    header, or None for absent/malformed/all-zero ids (the spec says an
+    invalid header is ignored, not an error)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if not m:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    try:
+        sampled = bool(int(flags, 16) & 0x01)
+    except ValueError:  # unreachable given the regex; belt and braces
+        return None
+    return trace_id, span_id, sampled
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+# id generation: a urandom-seeded Mersenne generator, NOT uuid4 — ids are
+# identity, not security, and uuid4's per-call urandom read costs ~18us
+# where getrandbits costs <1us (a trace mints ~a dozen ids; uuid4 alone
+# was half the measured enabled-tracing overhead on the hot loop).
+# Module-level shared instance: getrandbits is a single C call, atomic
+# under the GIL, so the event loop and the scoring executor thread can
+# both mint ids without a lock.
+_ID_RNG = random.Random(int.from_bytes(os.urandom(16), "big"))
+
+
+def _new_trace_id() -> str:
+    return f"{_ID_RNG.getrandbits(128):032x}"
+
+
+def _new_span_id() -> str:
+    return f"{_ID_RNG.getrandbits(64):016x}"
+
+
+class Span:
+    """One named, timed operation inside a trace.
+
+    ``start``/``end`` are ``time.monotonic()`` seconds; a span may be
+    created open (``end is None``) and closed later, or recorded whole
+    with explicit timestamps (``Trace.add_span``) when the boundary
+    events were measured elsewhere — the engine's ``queue_wait`` is
+    enqueue -> dispatch, both observed before the span object exists."""
+
+    __slots__ = ("name", "span_id", "parent_id", "start", "end", "error", "attributes")
+
+    def __init__(
+        self,
+        name: str,
+        span_id: str,
+        parent_id: Optional[str],
+        start: float,
+        end: Optional[float] = None,
+        error: bool = False,
+        attributes: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end = end
+        self.error = error
+        self.attributes = attributes or {}
+
+    @property
+    def duration_s(self) -> float:
+        return max(0.0, (self.end if self.end is not None else self.start) - self.start)
+
+    def close(self, error: bool = False) -> None:
+        if self.end is None:
+            self.end = time.monotonic()
+        if error:
+            self.error = True
+
+
+class Trace:
+    """All spans of one request/build, rooted at a single root span.
+
+    The root opens at construction and closes at :meth:`finish`, which
+    commits the trace to its tracer's ring/reservoir. Span appends are
+    plain list appends (GIL-atomic): the event loop and the scoring
+    executor thread both record into in-flight traces. Readers only see
+    a trace after ``finish`` publishes it.
+    """
+
+    __slots__ = (
+        "tracer",
+        "trace_id",
+        "name",
+        "request_id",
+        "parent_span_id",
+        "keep_recent",
+        "retained",
+        "spans",
+        "root",
+        "wall_start",
+        "_finished",
+    )
+
+    def __init__(
+        self,
+        tracer: Optional["Tracer"],
+        name: str,
+        trace_id: Optional[str] = None,
+        request_id: Optional[str] = None,
+        parent_span_id: Optional[str] = None,
+        keep_recent: bool = True,
+    ):
+        self.tracer = tracer
+        self.trace_id = trace_id or _new_trace_id()
+        self.name = name
+        self.request_id = request_id
+        self.parent_span_id = parent_span_id
+        self.keep_recent = keep_recent
+        # set by Tracer._commit: True iff the finished trace actually
+        # landed in the ring or the slow reservoir — references to a
+        # trace id (exemplars, logs) should only be published when this
+        # is True, or they dangle on a head-sample drop
+        self.retained = False
+        self.wall_start = time.time()
+        self.root = Span(name, _new_span_id(), None, time.monotonic())
+        self.spans: List[Span] = [self.root]
+        self._finished = False
+
+    # --------------------------- recording ---------------------------- #
+
+    def start_span(
+        self, name: str, parent: Optional[Span] = None, **attributes: Any
+    ) -> Span:
+        """Open a span now; close it with ``span.close()``. Parent
+        defaults to the root."""
+        span = Span(
+            name,
+            _new_span_id(),
+            (parent or self.root).span_id,
+            time.monotonic(),
+            attributes=attributes or None,
+        )
+        self.spans.append(span)
+        return span
+
+    def add_span(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        parent: Optional[Span] = None,
+        error: bool = False,
+        **attributes: Any,
+    ) -> Span:
+        """Record a completed span from boundary timestamps measured
+        elsewhere (monotonic seconds)."""
+        span = Span(
+            name,
+            _new_span_id(),
+            (parent or self.root).span_id,
+            start,
+            end=max(start, end),
+            error=error,
+            attributes=attributes or None,
+        )
+        self.spans.append(span)
+        return span
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Optional[Span] = None, **attributes: Any):
+        """Context manager: the span closes on exit, with ``error=True``
+        when the block raised (the exception propagates)."""
+        span = self.start_span(name, parent=parent, **attributes)
+        try:
+            yield span
+        except BaseException:
+            span.close(error=True)
+            raise
+        else:
+            span.close()
+
+    def finish(self, error: bool = False, **attributes: Any) -> None:
+        """Close the root and publish the trace. Idempotent: retry paths
+        and shutdown sweeps may race one request's natural completion."""
+        if self._finished:
+            return
+        self._finished = True
+        if attributes:
+            self.root.attributes.update(attributes)
+        # an abandoned child (its owner crashed between start and close)
+        # must not export as a still-open span pinning "now" forever
+        for span in self.spans:
+            if span.end is None and span is not self.root:
+                span.close(error=True)
+        self.root.close(error=error)
+        if self.tracer is not None:
+            self.tracer._commit(self)
+
+    # ----------------------------- reads ------------------------------ #
+
+    @property
+    def finished(self) -> bool:
+        return self._finished
+
+    @property
+    def duration_s(self) -> float:
+        return self.root.duration_s
+
+    @property
+    def error(self) -> bool:
+        return any(s.error for s in self.spans)
+
+    def _span_dict(self, span: Span, children: Dict[Optional[str], List[Span]]) -> dict:
+        out: Dict[str, Any] = {
+            "name": span.name,
+            "span_id": span.span_id,
+            "start_ms": round((span.start - self.root.start) * 1e3, 3),
+            "duration_ms": round(span.duration_s * 1e3, 3),
+        }
+        if span.error:
+            out["error"] = True
+        if span.attributes:
+            out["attributes"] = dict(span.attributes)
+        kids = children.get(span.span_id)
+        if kids:
+            out["children"] = [self._span_dict(k, children) for k in kids]
+        return out
+
+    def tree(self) -> dict:
+        """Nested span tree (children sorted by start time)."""
+        children: Dict[Optional[str], List[Span]] = {}
+        for span in self.spans:
+            if span is not self.root:
+                children.setdefault(span.parent_id, []).append(span)
+        for kids in children.values():
+            kids.sort(key=lambda s: s.start)
+        # orphans (parent span object never registered) re-root so they
+        # stay visible rather than silently vanishing from the tree
+        known = {s.span_id for s in self.spans}
+        for pid in list(children):
+            if pid not in known:
+                children.setdefault(self.root.span_id, []).extend(children.pop(pid))
+        return self._span_dict(self.root, children)
+
+    def summary(self, spans: bool = True) -> dict:
+        out: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "request_id": self.request_id,
+            "start_unix": round(self.wall_start, 3),
+            "duration_ms": round(self.duration_s * 1e3, 3),
+            "error": self.error,
+            "n_spans": len(self.spans),
+        }
+        if spans:
+            out["spans"] = self.tree()
+        return out
+
+
+def chrome_trace(traces: Iterable[Trace]) -> dict:
+    """Chrome trace-event JSON for one or more traces: complete events
+    (``ph: "X"``) with microsecond ``ts``/``dur``, one ``pid`` per trace
+    so multiple requests render side by side in Perfetto. Timestamps are
+    wall-anchored at each trace's start so concurrent traces align."""
+    events: List[dict] = []
+    for pid, trace in enumerate(traces, start=1):
+        events.append(
+            {
+                "ph": "M",
+                "pid": pid,
+                "name": "process_name",
+                "args": {
+                    "name": f"{trace.name} {trace.trace_id[:8]}"
+                    + (f" rid={trace.request_id}" if trace.request_id else "")
+                },
+            }
+        )
+        base = trace.root.start
+        anchor_us = trace.wall_start * 1e6
+        for span in trace.spans:
+            args: Dict[str, Any] = {"trace_id": trace.trace_id}
+            if span.attributes:
+                args.update(span.attributes)
+            if span.error:
+                args["error"] = True
+            events.append(
+                {
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": 1,
+                    "name": span.name,
+                    "cat": trace.name,
+                    "ts": round(anchor_us + (span.start - base) * 1e6, 3),
+                    "dur": round(span.duration_s * 1e6, 3),
+                    "args": args,
+                }
+            )
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+class Tracer:
+    """Process/app-scoped trace source, retention, and flight recorder.
+
+    ``sample`` <= 0 disables tracing: ``start_trace`` returns ``None``
+    and every instrumented call site skips on that single check (the
+    near-free-when-disabled contract, guarded by the hot-loop overhead
+    test). With ``0 < sample``, EVERY request records spans; ``sample``
+    head-controls which completed traces enter the recent ring, while
+    the slow reservoir (worst-N by duration) considers all of them —
+    head-sampling for volume, always-sample-slow for the tail.
+    """
+
+    def __init__(
+        self,
+        sample: Optional[float] = None,
+        ring: Optional[int] = None,
+        slow_keep: Optional[int] = None,
+    ):
+        if sample is None:
+            sample = _env_float("GORDO_TRACE_SAMPLE", 0.1)
+        if ring is None:
+            ring = int(_env_float("GORDO_TRACE_RING", 128))
+        if slow_keep is None:
+            slow_keep = int(_env_float("GORDO_TRACE_SLOW_KEEP", 16))
+        self.sample = float(sample)
+        self.slow_keep = max(1, slow_keep)
+        self._recent: "deque[Trace]" = deque(maxlen=max(1, ring))
+        self._slow: List[Tuple[float, int, Trace]] = []  # min-heap
+        self._seq = itertools.count()
+        self._rng = random.Random()
+        self._lock = threading.Lock()  # commit path only, never recording
+        self.started = 0
+        self.finished = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0.0
+
+    @property
+    def inflight(self) -> int:
+        """Traces started but not yet finished — a growing value under
+        load means a code path leaks open traces (the chaos suite
+        asserts this returns to zero)."""
+        return self.started - self.finished
+
+    def start_trace(
+        self,
+        name: str,
+        traceparent: Optional[str] = None,
+        request_id: Optional[str] = None,
+        force: bool = False,
+    ) -> Optional[Trace]:
+        """New in-flight trace, or ``None`` when tracing is disabled.
+
+        A valid ``traceparent`` continues the upstream trace id; its
+        ``sampled`` flag (or ``force=True``) pins the trace into the
+        recent ring regardless of head sampling."""
+        if self.sample <= 0.0:
+            return None
+        trace_id = parent_span = None
+        upstream_sampled = False
+        parsed = parse_traceparent(traceparent)
+        if parsed is not None:
+            trace_id, parent_span, upstream_sampled = parsed
+        keep = (
+            force
+            or upstream_sampled
+            or self.sample >= 1.0
+            or self._rng.random() < self.sample
+        )
+        self.started += 1
+        return Trace(
+            self,
+            name,
+            trace_id=trace_id,
+            request_id=request_id,
+            parent_span_id=parent_span,
+            keep_recent=keep,
+        )
+
+    def _commit(self, trace: Trace) -> None:
+        self.finished += 1
+        with self._lock:
+            if trace.keep_recent:
+                self._recent.append(trace)
+                trace.retained = True
+            # the flight recorder: every completed trace competes for the
+            # worst-N reservoir, so slow requests survive head sampling
+            item = (trace.duration_s, next(self._seq), trace)
+            if len(self._slow) < self.slow_keep:
+                heapq.heappush(self._slow, item)
+                trace.retained = True
+            elif item[0] > self._slow[0][0]:
+                heapq.heapreplace(self._slow, item)
+                trace.retained = True
+
+    # ----------------------------- reads ------------------------------ #
+
+    def recent(self, n: Optional[int] = None) -> List[Trace]:
+        """Completed retained traces, most recent first. ``n`` <= 0 (or
+        None) returns everything — a negative slice must never silently
+        drop the newest traces."""
+        with self._lock:
+            out = list(self._recent)
+        out.reverse()
+        return out[:n] if n is not None and n > 0 else out
+
+    def slow(self, n: Optional[int] = None) -> List[Trace]:
+        """The reservoir's worst traces, slowest first; same ``n``
+        semantics as :meth:`recent`."""
+        with self._lock:
+            out = [t for _, _, t in sorted(self._slow, reverse=True)]
+        return out[:n] if n is not None and n > 0 else out
+
+    def find(self, trace_id: str) -> List[Trace]:
+        """Retained traces matching ``trace_id`` (ring + reservoir)."""
+        with self._lock:
+            seen = []
+            for t in list(self._recent) + [t for _, _, t in self._slow]:
+                if t.trace_id == trace_id and t not in seen:
+                    seen.append(t)
+        return seen
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+# process-default tracer (builder/bench processes trace without plumbing;
+# the server builds a per-app tracer, same split as the metrics registry)
+_DEFAULT: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Tracer()
+    return _DEFAULT
+
+
+# ------------------------------------------------------------------ #
+# current-trace propagation (builder path: build_fleet sets it, the
+# fleet trainer's bucket loop and checkpoint writer read it — no
+# parameter threading through six call layers)
+# ------------------------------------------------------------------ #
+
+_CURRENT: "contextvars.ContextVar[Optional[Trace]]" = contextvars.ContextVar(
+    "gordo_current_trace", default=None
+)
+
+
+def current_trace() -> Optional[Trace]:
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace: Optional[Trace]):
+    token = _CURRENT.set(trace)
+    try:
+        yield trace
+    finally:
+        _CURRENT.reset(token)

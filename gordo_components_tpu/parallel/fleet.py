@@ -36,6 +36,7 @@ import numpy as np
 from gordo_components_tpu.models import train_core
 from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.observability import get_registry
+from gordo_components_tpu.observability.tracing import current_trace
 from gordo_components_tpu.ops.scaler import (
     ScalerParams,
     fit_minmax,
@@ -840,6 +841,21 @@ class FleetTrainer:
         self.quantize_members = bool(quantize_members)
         self.factory_kwargs = factory_kwargs
         self.last_stats: Dict[str, Any] = {}
+        # (trace, open fit span) for the bucket currently training — the
+        # checkpoint writer nests its spans under it (observability/tracing)
+        self._trace_span: Optional[Tuple[Any, Any]] = None
+
+    def _trace_checkpoint(self, start: float, epoch: int, error: bool = False) -> None:
+        """Record one checkpoint save as a span under the active bucket's
+        ``fit`` span; no-op outside a build trace."""
+        ts = self._trace_span
+        if ts is None:
+            return
+        trace, fit_span = ts
+        trace.add_span(
+            "checkpoint", start, time.monotonic(), parent=fit_span,
+            epoch=int(epoch), error=error,
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -931,9 +947,21 @@ class FleetTrainer:
         bucket_stats = []
         self._g_members_total.set(len(members))
         self._g_members_trained.set(0)
+        # build-trace context (observability/tracing.py): when the caller
+        # (build_fleet) opened a trace, every bucket records a ``fit``
+        # span with ``compile``/``checkpoint`` children — the builder-side
+        # counterpart of the serving stage spans
+        trace = current_trace()
         for (n_features, padded_rows), names in sorted(buckets.items()):
             tb = time.time()
+            blabel = f"f{n_features}x{padded_rows}"
             self._active_ckpt = None
+            fit_span = None
+            if trace is not None:
+                fit_span = trace.start_span(
+                    f"fit:{blabel}", bucket=blabel, members=len(names)
+                )
+                self._trace_span = (trace, fit_span)
             try:
                 res, epoch_seconds, padded_m = self._fit_bucket(
                     n_features, padded_rows, names, arrays
@@ -951,19 +979,36 @@ class FleetTrainer:
                         logger.warning("checkpoint flush failed", exc_info=True)
                     finally:
                         ckpt.close()
+                if fit_span is not None:
+                    fit_span.close(error=True)
                 raise
             finally:
                 self._active_ckpt = None
+                self._trace_span = None
             out.update(res)
             self._g_members_trained.set(len(out))
             # per-bucket compile visibility: epoch 0 carries the XLA
             # compile (bucket_stats records the same split); the gauge
             # makes it scrapeable/snapshotable without parsing metadata
-            blabel = f"f{n_features}x{padded_rows}"
             compile_s = 0.0
             if epoch_seconds:
                 steady = min(epoch_seconds[1:]) if len(epoch_seconds) > 1 else 0.0
                 compile_s = max(0.0, epoch_seconds[0] - steady)
+            if fit_span is not None:
+                fit_span.attributes["epochs"] = len(epoch_seconds)
+                fit_span.close()
+                if compile_s > 0:
+                    # the compile window is epoch 0's excess over steady
+                    # state — an ESTIMATE anchored at bucket start, and
+                    # flagged as such
+                    trace.add_span(
+                        "compile",
+                        fit_span.start,
+                        fit_span.start + compile_s,
+                        parent=fit_span,
+                        bucket=blabel,
+                        estimated=True,
+                    )
             reg.counter(
                 "gordo_fleet_bucket_builds_total",
                 "Bucket training runs", ("bucket",),
@@ -1253,6 +1298,7 @@ class FleetTrainer:
                     start_epoch = 0
 
         def save_checkpoint(epoch):
+            t_ck = time.monotonic()
             try:
                 tosave = {"state": dict(
                     (str(i), leaf) for i, leaf in enumerate(jax.tree.leaves(states))
@@ -1288,6 +1334,9 @@ class FleetTrainer:
                     "fleet checkpoint save failed at epoch %d; training "
                     "continues without it", epoch, exc_info=True,
                 )
+                self._trace_checkpoint(t_ck, epoch, error=True)
+            else:
+                self._trace_checkpoint(t_ck, epoch)
 
         epoch_times: List[float] = []
         sync = max(1, int(self.host_sync_every))

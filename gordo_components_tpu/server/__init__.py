@@ -16,7 +16,8 @@ from typing import Optional
 
 from aiohttp import web
 
-from gordo_components_tpu.observability import MetricsRegistry
+from gordo_components_tpu.observability import MetricsRegistry, Tracer
+from gordo_components_tpu.observability.tracing import format_traceparent
 from gordo_components_tpu.resilience import QuarantineSet, configure_from_env
 from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 from gordo_components_tpu.server.model_io import ModelCollection
@@ -30,17 +31,36 @@ logger = logging.getLogger(__name__)
 _RID_SEQ = itertools.count(1)
 
 
+def _trace_headers(headers, rid: str, trace) -> None:
+    """Stamp the id headers every response must carry: the gordo request
+    id, the generic ``X-Request-Id`` (the trace id when traced, so an
+    operator pastes it straight into ``GET /traces?id=``), and the W3C
+    ``traceparent`` continuing the request's trace context downstream."""
+    headers["X-Gordo-Request-Id"] = rid
+    headers["X-Request-Id"] = trace.trace_id if trace is not None else rid
+    if trace is not None:
+        headers["traceparent"] = format_traceparent(
+            trace.trace_id, trace.root.span_id
+        )
+
+
 @web.middleware
 async def _stats_middleware(request, handler):
     """Per-endpoint-kind request/error counters + service-time histograms
-    for ``GET .../stats``, plus request-id propagation: the client's
+    for ``GET .../stats``, plus request-id/trace propagation: the client's
     ``X-Gordo-Request-Id`` header (or a server-generated id) is stashed on
     the request, echoed on the response, and logged in the access line —
     so a latency-histogram outlier or an engine-batch failure is traceable
-    back to one request. Single event-loop thread: plain dict/int
-    mutation is safe. Counter keys come from the matched route TEMPLATE
-    (a bounded set) — keying on raw paths would let a scanner probing
-    random URLs grow the dict without bound."""
+    back to one request. When the app carries a tracer
+    (observability/tracing.py), a request-scoped trace opens here (W3C
+    ``traceparent`` in, root span = endpoint kind), rides the request
+    through the engine/bank stage spans, and closes with the response —
+    its id echoed in ``X-Request-Id``/``traceparent`` and attached as an
+    exemplar on the request-latency bucket it landed in, so a histogram
+    spike resolves to one retrievable trace. Single event-loop thread:
+    plain dict/int mutation is safe. Counter keys come from the matched
+    route TEMPLATE (a bounded set) — keying on raw paths would let a
+    scanner probing random URLs grow the dict without bound."""
     stats = request.app["stats"]
     resource = getattr(request.match_info.route, "resource", None)
     canonical = getattr(resource, "canonical", None)
@@ -59,6 +79,16 @@ async def _stats_middleware(request, handler):
         f"srv-{next(_RID_SEQ):x}"
     )
     request["request_id"] = rid
+    tracer = request.app.get("tracer")
+    trace = None
+    if tracer is not None:
+        trace = tracer.start_trace(
+            kind,
+            traceparent=request.headers.get("traceparent"),
+            request_id=rid,
+        )
+        if trace is not None:
+            request["trace"] = trace
     t0 = time.monotonic()
     status = 500  # a non-HTTP handler crash surfaces as a 500
     counted = False
@@ -67,7 +97,7 @@ async def _stats_middleware(request, handler):
         status = resp.status
     except web.HTTPException as exc:
         status = exc.status
-        exc.headers["X-Gordo-Request-Id"] = rid
+        _trace_headers(exc.headers, rid, trace)
         if exc.status >= 400:
             stats["errors"] += 1
         raise
@@ -91,11 +121,33 @@ async def _stats_middleware(request, handler):
         # exactly what a tail-latency histogram exists to surface
         elapsed = time.monotonic() - t0
         hist.record(elapsed)
+        if trace is not None:
+            trace.finish(error=status >= 400, status=status)
+            # exemplar-style link on the latency histogram: the LAST trace
+            # to land in each bucket, keyed by the bucket's le edge
+            # (formatted EXACTLY as the Prometheus exposition formats it,
+            # so the strings join against the scraped histogram) — bounded
+            # at O(buckets) per kind, surfaced through /stats so "p99
+            # spiked" resolves to "this trace" in two clicks. Only
+            # RETAINED traces publish an exemplar: a head-sample drop must
+            # not leave a dangling id the /traces lookup can't resolve
+            if trace.retained:
+                from gordo_components_tpu.observability.metrics import _fmt
+
+                # _fmt renders inf as "+Inf", matching the bucket labels
+                stats.setdefault("exemplars", {}).setdefault(kind, {})[
+                    _fmt(hist.bucket_le(elapsed))
+                ] = {
+                    "trace_id": trace.trace_id,
+                    "value_ms": round(elapsed * 1e3, 3),
+                    "at": round(time.time(), 3),
+                }
         logger.debug(
-            "access rid=%s %s %s %d %.1fms",
-            rid, request.method, request.path, status, elapsed * 1e3,
+            "access rid=%s trace=%s %s %s %d %.1fms",
+            rid, trace.trace_id if trace is not None else "-",
+            request.method, request.path, status, elapsed * 1e3,
         )
-    resp.headers["X-Gordo-Request-Id"] = rid
+    _trace_headers(resp.headers, rid, trace)
     if not counted and resp.status >= 400:
         stats["errors"] += 1
     return resp
@@ -251,7 +303,14 @@ def build_app(
         "requests": {},
         "errors": 0,
         "latency": {},
+        "exemplars": {},
     }
+    # per-app request tracer (observability/tracing.py): the middleware
+    # opens a trace per request, the engine/bank record stage spans into
+    # it, and ``GET .../traces`` serves the ring + slow reservoir.
+    # ``GORDO_TRACE_SAMPLE=0`` disables tracing entirely (start_trace
+    # returns None and every call site skips on that one check)
+    app["tracer"] = Tracer()
     # per-app metrics registry (observability/): the bank router and the
     # batching engine record per-shard/per-bucket series here; ``GET
     # .../metrics`` renders it as Prometheus text and ``GET .../stats``

@@ -26,8 +26,10 @@ surfaced per model through ``ModelBank.coverage`` and ``GET /models``.
 """
 
 import asyncio
+import contextlib
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -671,17 +673,37 @@ class ModelBank:
 
     # --------------------------- scoring ------------------------------ #
 
-    def score(self, name: str, X: np.ndarray, y: Optional[np.ndarray] = None) -> ScoreResult:
+    def score(
+        self,
+        name: str,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        trace=None,
+    ) -> ScoreResult:
         """Score one request (convenience wrapper over ``score_many``)."""
-        return self.score_many([(name, X, y)])[0]
+        return self.score_many(
+            [(name, X, y)], traces=None if trace is None else [trace]
+        )[0]
 
     def score_many(
-        self, requests: Sequence[Tuple[str, np.ndarray, Optional[np.ndarray]]]
+        self,
+        requests: Sequence[Tuple[str, np.ndarray, Optional[np.ndarray]]],
+        traces: Optional[Sequence[Any]] = None,
     ) -> List[ScoreResult]:
         """Score a heterogeneous batch of (name, X, y) requests.
 
         Requests are grouped by bucket, padded to pow2 (batch, rows) and
         scored in one XLA call per group.
+
+        ``traces`` (optional, request-aligned; entries may be None) are
+        :class:`~gordo_components_tpu.observability.tracing.Trace`
+        objects to record the hot-path stage spans into — ``coalesce``
+        (group/validate/chunk), ``pad`` (batch assembly), and
+        ``device_execute``/``postprocess`` with the device work fenced by
+        ``jax.block_until_ready`` so execution and host transfer stop
+        blurring together. The whole stage-timing path is skipped when no
+        request in a group is traced (the near-free-when-disabled
+        contract; see the tracing hot-loop overhead guard).
         """
         _FP_SCORE.fire()
         results: List[Optional[ScoreResult]] = [None] * len(requests)
@@ -693,6 +715,12 @@ class ModelBank:
 
         for key, req_ids in by_bucket.items():
             bucket = self._buckets[key]
+            group_traces = None
+            if traces is not None:
+                group_traces = [
+                    t for t in (traces[ri] for ri in req_ids) if t is not None
+                ] or None
+            t_group = time.monotonic() if group_traces else 0.0
             F = bucket.n_features
             off = bucket.offset
             rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
@@ -735,6 +763,7 @@ class ModelBank:
                     chunks.append(
                         (ri, start, X[start : start + T], Y[start : start + T])
                     )
+            t_chunks = time.monotonic() if group_traces else 0.0
             # slots[ci]: where chunk ci landed in the batched output —
             # a flat index (single-device) or a (device, local-slot) pair
             # (mesh routing)
@@ -762,7 +791,7 @@ class ModelBank:
                     self._m_shard_rows.labels("0").inc(routed0)
                     self._m_shard_pad.labels("0").inc(B * T - routed0)
                     self._m_shard_reqs.labels("0").inc(len(chunks))
-                out = bucket.score_batch(idx, Xb, Yb)
+                score_fn = bucket.score_batch
             else:
                 # route each chunk to the shard owning its model: the
                 # stacked leading axis is split into n_shards contiguous
@@ -794,7 +823,35 @@ class ModelBank:
                         self._m_shard_rows.labels(sl).inc(routed_d)
                         self._m_shard_pad.labels(sl).inc(Bl * T - routed_d)
                         self._m_shard_reqs.labels(sl).inc(len(cis))
-                out = bucket.score_batch_sharded(idx, Xb, Yb)
+                score_fn = bucket.score_batch_sharded
+            if group_traces is None:
+                out = score_fn(idx, Xb, Yb)
+                t_pad = t_exec = 0.0
+                profile_dir = None
+            else:
+                t_pad = time.monotonic()
+                # optional JAX profiler capture of exactly this dispatch
+                # (utils/profiling.maybe_profile, armed by
+                # GORDO_PROFILE_DIR): the profiler trace directory is
+                # named by the request's trace id, so the span tree and
+                # the op-level timeline share one identity — the span's
+                # ``profile`` attribute links them
+                profile_dir = None
+                prof: Any = contextlib.nullcontext()
+                prof_root = os.environ.get("GORDO_PROFILE_DIR")
+                if prof_root:
+                    from gordo_components_tpu.utils.profiling import maybe_profile
+
+                    prof_name = f"serve-{group_traces[0].trace_id}"
+                    profile_dir = os.path.join(prof_root, prof_name)
+                    prof = maybe_profile(prof_name)
+                with prof:
+                    out = score_fn(idx, Xb, Yb)
+                    # fence: device execution ends HERE, so the
+                    # device_execute span measures XLA, not the host-side
+                    # transfer/reassembly that follows
+                    jax.block_until_ready(out)
+                t_exec = time.monotonic()
             # one transfer for all five outputs (device_get batches the
             # D2H copies) instead of five blocking np.asarray round-trips
             recon, diff, scaled, tot_u, tot_s = jax.device_get(out)
@@ -834,6 +891,28 @@ class ModelBank:
                     total_scaled=cat(tot_s),
                     offset=off,
                 )
+            if group_traces:
+                # the stage boundaries are per coalesced GROUP: every
+                # traced request in it gets the same span timestamps —
+                # per-request attribution of the shared batch's cost,
+                # which is exactly what coalescing makes invisible in a
+                # plain latency histogram
+                t_done = time.monotonic()
+                for ri in req_ids:
+                    tr = traces[ri]  # type: ignore[index]
+                    if tr is None:
+                        continue
+                    tr.add_span(
+                        "coalesce", t_group, t_chunks,
+                        bucket=bucket.label, requests=len(req_ids),
+                        chunks=len(chunks),
+                    )
+                    tr.add_span("pad", t_chunks, t_pad)
+                    exec_attrs: Dict[str, Any] = {"bucket": bucket.label}
+                    if profile_dir is not None:
+                        exec_attrs["profile"] = profile_dir
+                    tr.add_span("device_execute", t_pad, t_exec, **exec_attrs)
+                    tr.add_span("postprocess", t_exec, t_done)
         return results  # type: ignore[return-value]
 
 
@@ -854,6 +933,10 @@ class _Pending:
     # server-generated): failures inside the coalesced batch stay
     # traceable to the access-log line that admitted the request
     request_id: Optional[str] = None
+    # request-scoped Trace (observability/tracing.py) riding through the
+    # queue: the engine records queue_wait at dispatch and the bank
+    # records the batch stage spans into it; None when tracing is off
+    trace: Optional[Any] = None
 
 
 class EngineOverloaded(Exception):
@@ -994,6 +1077,7 @@ class BatchingEngine:
         X: np.ndarray,
         y: Optional[np.ndarray] = None,
         request_id: Optional[str] = None,
+        trace=None,
     ) -> ScoreResult:
         _FP_ENGINE_QUEUE.fire()
         self.start()
@@ -1020,7 +1104,7 @@ class BatchingEngine:
             )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put(
-            _Pending(name, X, y, fut, time.monotonic(), request_id)
+            _Pending(name, X, y, fut, time.monotonic(), request_id, trace)
         )
         return await fut
 
@@ -1072,21 +1156,57 @@ class BatchingEngine:
             self.stats["batches"] += 1
             self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], len(batch))
             dispatch = time.monotonic()
+            traced = False
             for p in batch:
                 self.queue_wait.record(dispatch - p.enqueued)
+                if p.trace is not None:
+                    traced = True
+                    # the coalescing window's per-request cost, named:
+                    # submit -> batch dispatch, with the batch size the
+                    # wait bought as an attribute
+                    p.trace.add_span(
+                        "queue_wait", p.enqueued, dispatch, batch=len(batch)
+                    )
             requests = [(p.name, p.X, p.y) for p in batch]
             try:
-                results = await loop.run_in_executor(
-                    None, self.bank.score_many, requests
-                )
+                # the traces argument only rides along when some request
+                # in the batch is actually traced: bank proxies/stubs with
+                # the minimal score_many(requests) signature keep working
+                if traced:
+                    results = await loop.run_in_executor(
+                        None, self.bank.score_many, requests,
+                        [p.trace for p in batch],
+                    )
+                else:
+                    results = await loop.run_in_executor(
+                        None, self.bank.score_many, requests
+                    )
             except Exception:
                 # one bad request must not poison the batch: retry each
                 # request alone so errors land only on their own future
                 for p in batch:
                     try:
-                        r = await loop.run_in_executor(
-                            None, self.bank.score, p.name, p.X, p.y
-                        )
+                        # carry the trace into the retry ONLY if the
+                        # failed batch call never recorded stage spans for
+                        # this request (its bucket group died before the
+                        # span block) — a request whose group completed
+                        # before another group raised would otherwise get
+                        # a duplicate coalesce/pad/execute/postprocess set
+                        retry_trace = p.trace
+                        if retry_trace is not None and any(
+                            s.name == "device_execute"
+                            for s in retry_trace.spans
+                        ):
+                            retry_trace = None
+                        if retry_trace is not None:
+                            r = await loop.run_in_executor(
+                                None, self.bank.score,
+                                p.name, p.X, p.y, retry_trace,
+                            )
+                        else:
+                            r = await loop.run_in_executor(
+                                None, self.bank.score, p.name, p.X, p.y
+                            )
                     except Exception as exc:
                         # rid ties this failure back to the access-log
                         # line (and the client header) that admitted it

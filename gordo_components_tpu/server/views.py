@@ -27,6 +27,7 @@ import pandas as pd
 from aiohttp import web
 
 from gordo_components_tpu import __version__, serializer
+from gordo_components_tpu.observability.tracing import chrome_trace
 from gordo_components_tpu.server.bank import EngineOverloaded
 from gordo_components_tpu.server.utils import extract_x_y, frame_to_dict
 from gordo_components_tpu.utils import parquet_engine_available
@@ -273,6 +274,72 @@ async def metrics_exposition(request: web.Request) -> web.Response:
     )
 
 
+def _tracer_or_disabled(request: web.Request):
+    tracer = request.app.get("tracer")
+    if tracer is None or not tracer.enabled:
+        return None
+    return tracer
+
+
+def _query_n(request: web.Request, default: str) -> Any:
+    """``?n=`` as a non-negative int (0 = unbounded), else 400 — a
+    negative value must not silently slice away the newest/slowest
+    traces (``list[:-n]``), which are the ones the caller wants."""
+    try:
+        n = int(request.query.get("n", default))
+    except ValueError:
+        n = -1
+    if n < 0:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "n must be a non-negative integer"}),
+            content_type="application/json",
+        )
+    return n or None
+
+
+def _traces_response(request: web.Request, traces) -> web.Response:
+    """Shared tail for the trace endpoints: ``?format=chrome`` exports
+    Chrome trace-event JSON (opens directly in chrome://tracing /
+    Perfetto), the default is the summary+span-tree JSON."""
+    if request.query.get("format") == "chrome":
+        return web.json_response(chrome_trace(traces))
+    return web.json_response(
+        {"enabled": True, "traces": [t.summary() for t in traces]}
+    )
+
+
+@routes.get("/gordo/v0/{project}/traces")
+async def traces_recent(request: web.Request) -> web.Response:
+    """Recent retained traces (newest first), from the tracer's bounded
+    ring. ``?id=<trace_id>`` retrieves one trace (ring + slow reservoir),
+    ``?n=<count>`` bounds the list, ``?format=chrome`` exports the Trace
+    Event Format. Sampling: head-sampled by ``GORDO_TRACE_SAMPLE``; a
+    request carrying a ``traceparent`` with the sampled flag is always
+    retained."""
+    tracer = _tracer_or_disabled(request)
+    if tracer is None:
+        return web.json_response({"enabled": False, "traces": []})
+    trace_id = request.query.get("id")
+    if trace_id:
+        return _traces_response(request, tracer.find(trace_id))
+    return _traces_response(
+        request, tracer.recent(_query_n(request, default="50"))
+    )
+
+
+@routes.get("/gordo/v0/{project}/traces/slow")
+async def traces_slow(request: web.Request) -> web.Response:
+    """The slow-request flight recorder: worst-N traces by duration,
+    slowest first — retained regardless of head sampling, so the tail is
+    always explorable. Same ``?n=``/``?format=chrome`` options."""
+    tracer = _tracer_or_disabled(request)
+    if tracer is None:
+        return web.json_response({"enabled": False, "traces": []})
+    return _traces_response(
+        request, tracer.slow(_query_n(request, default="0"))
+    )
+
+
 @routes.get("/gordo/v0/{project}/stats")
 async def server_stats(request: web.Request) -> web.Response:
     """Serving-process observability (SURVEY.md §5 metrics): request
@@ -293,6 +360,12 @@ async def server_stats(request: web.Request) -> web.Response:
             kind: hist.snapshot()
             for kind, hist in stats.get("latency", {}).items()
         },
+        # exemplar-style links from latency buckets to traces: per
+        # endpoint kind, the last trace id to land in each histogram
+        # bucket (keyed by the bucket's le edge) — paste the trace_id
+        # into GET .../traces?id=... to see where that request's time
+        # went (metric spike -> offending trace in two clicks)
+        "exemplars": stats.get("exemplars", {}),
     }
     engine = request.app.get("bank_engine")
     if engine is not None:
@@ -516,19 +589,28 @@ async def prediction(request: web.Request) -> web.Response:
             text=json.dumps({"error": str(exc)}), content_type="application/json"
         )
     engine = _bank_engine(request)
+    trace = request.get("trace")
     try:
         if engine is not None:
             result = await engine.score(
                 target,
                 X.values.astype("float32"),
                 request_id=request.get("request_id"),
+                trace=trace,
             )
             output = result.model_output
         else:
             loop = asyncio.get_running_loop()
+            t0 = time.monotonic()
             output = await loop.run_in_executor(
                 None, model.predict, X.values.astype("float32")
             )
+            if trace is not None:
+                # per-model fallback path: no coalescing stages, but the
+                # device work still gets its named span
+                trace.add_span(
+                    "device_execute", t0, time.monotonic(), path="per-model"
+                )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
     except Exception as exc:  # surface model errors as 400s with detail
@@ -565,6 +647,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
             text=json.dumps({"error": str(exc)}), content_type="application/json"
         )
     engine = _bank_engine(request)
+    trace = request.get("trace")
     try:
         if engine is not None:
             result = await engine.score(
@@ -572,11 +655,20 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 X.values.astype("float32"),
                 None if y is None else y.values.astype("float32"),
                 request_id=request.get("request_id"),
+                trace=trace,
             )
+            t0 = time.monotonic()
             frame = result.to_frame(index=X.index)
+            if trace is not None:
+                trace.add_span("postprocess", t0, time.monotonic(), stage="to_frame")
         else:
             loop = asyncio.get_running_loop()
+            t0 = time.monotonic()
             frame = await loop.run_in_executor(None, model.anomaly, X, y)
+            if trace is not None:
+                trace.add_span(
+                    "device_execute", t0, time.monotonic(), path="per-model"
+                )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
     except Exception as exc:

@@ -433,6 +433,87 @@ class WatchmanState:
             self._metrics_time = now
             return self._metrics_cache
 
+    def _trace_urls(self) -> List[str]:
+        """Per-replica slow-trace endpoints, derived from the metrics
+        scrape targets (same replica set, sibling path)."""
+        urls = self.metrics_urls or [
+            f"{self.base_url}/gordo/v0/{self.project}/metrics"
+        ]
+        suffix = "/metrics"
+        out = []
+        for u in urls:
+            u = u.rstrip("/")  # tolerate a trailing slash on the target
+            if u.endswith(suffix):
+                u = u[: -len(suffix)]
+            out.append(u + "/traces/slow")
+        return out
+
+    async def fleet_slow_traces(self, per_replica: int = 5) -> Dict[str, Any]:
+        """Fleet flight-recorder view: each replica's worst recent traces
+        (its slow reservoir, slowest first), plus a fleet-wide ``worst``
+        list merged across replicas — "which requests were slowest
+        ANYWHERE, and on which replica" in one fetch. Best-effort and
+        uncached (an operator debugging tool, not a poll target): a
+        replica that fails to answer is marked unscraped, never an
+        error."""
+        urls = self._trace_urls()
+        timeout = aiohttp.ClientTimeout(total=30)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def fetch(url):
+                async def get():
+                    async with session.get(
+                        url, params={"n": str(per_replica)}
+                    ) as resp:
+                        if resp.status != 200:
+                            return None
+                        return await resp.json()
+
+                try:
+                    return await asyncio.wait_for(get(), timeout=10.0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.debug("trace scrape failed for %s: %s", url, exc)
+                    return None
+
+            bodies = await asyncio.gather(*(fetch(u) for u in urls))
+        replicas: List[Dict[str, Any]] = []
+        worst: List[Dict[str, Any]] = []
+        for i, body in enumerate(bodies):
+            entry: Dict[str, Any] = {
+                "replica": i,
+                "scraped": body is not None,
+                "tracing_enabled": bool(body and body.get("enabled")),
+            }
+            if body and body.get("enabled"):
+                traces = body.get("traces") or []
+                entry["traces"] = traces
+                for t in traces:
+                    if not isinstance(t, dict):
+                        continue
+                    worst.append(
+                        {
+                            "replica": i,
+                            **{
+                                k: t.get(k)
+                                for k in (
+                                    "trace_id",
+                                    "name",
+                                    "request_id",
+                                    "duration_ms",
+                                    "error",
+                                )
+                            },
+                        }
+                    )
+            replicas.append(entry)
+        worst.sort(key=lambda t: -(t.get("duration_ms") or 0.0))
+        return {
+            "replicas": replicas,
+            "worst": worst[: max(per_replica, 10)],
+        }
+
     async def snapshot(self) -> Dict[str, Any]:
         async with self._lock:
             now = time.monotonic()
@@ -680,9 +761,28 @@ def build_watchman_app(
             headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
         )
 
+    async def traces(request: web.Request) -> web.Response:
+        """Fleet slow-trace view: every replica's worst recent traces
+        (``?n=`` per replica, default 5) plus the merged fleet-wide
+        ``worst`` list — the cross-replica companion to each server's
+        ``GET .../traces/slow``."""
+        try:
+            per_replica = int(request.query.get("n", "5"))
+        except ValueError:
+            per_replica = -1
+        if per_replica < 1:
+            raise web.HTTPBadRequest(
+                text='{"error": "n must be a positive integer"}',
+                content_type="application/json",
+            )
+        return web.json_response(
+            await state.fleet_slow_traces(per_replica=per_replica)
+        )
+
     app.router.add_get("/", root)
     app.router.add_get("/healthcheck", healthcheck)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/traces", traces)
     return app
 
 

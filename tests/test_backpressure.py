@@ -38,12 +38,14 @@ class _SlowBank:
     def __contains__(self, name):
         return name in self._bank
 
-    def score_many(self, requests):
+    def score_many(self, requests, traces=None):
         time.sleep(self.delay_s)
-        return self._bank.score_many(requests)
+        return self._bank.score_many(requests, traces=traces)
 
-    def score(self, name, X, y=None):
-        return self.score_many([(name, X, y)])[0]
+    def score(self, name, X, y=None, trace=None):
+        return self.score_many(
+            [(name, X, y)], traces=None if trace is None else [trace]
+        )[0]
 
 
 async def test_engine_sheds_past_max_queue(one_model):

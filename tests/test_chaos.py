@@ -396,6 +396,97 @@ async def test_engine_queue_latency_injection_slows_but_serves(artifact_dir):
 
 
 # ------------------------------------------------------------------ #
+# tracing under chaos: spans must close with error=true, never leak,
+# and the trace ring must stay bounded while faults churn requests
+# ------------------------------------------------------------------ #
+
+
+def _traceparent(tid: str) -> dict:
+    return {"traceparent": f"00-{tid}-{'cd' * 8}-01"}
+
+
+def _flat_names(node, out=None):
+    out = out if out is not None else []
+    out.append(node["name"])
+    for child in node.get("children", ()):
+        _flat_names(child, out)
+    return out
+
+
+async def test_scoring_fault_closes_trace_spans_with_error(
+    artifact_dir, monkeypatch
+):
+    """A request that dies inside the coalesced batch must still finish
+    its trace — root span error=true, every span closed, nothing left
+    in flight — or the flight recorder leaks exactly when it matters."""
+    monkeypatch.setenv("GORDO_TRACE_SAMPLE", "1")
+    resilience.arm("bank.score", exc=FaultInjected)
+    async with _client(artifact_dir, quarantine_threshold=0) as client:
+        tid = "ab" * 16
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-a/prediction",
+            json=_x_payload(),
+            headers=_traceparent(tid),
+        )
+        assert resp.status == 400
+        # the failed response still names its trace
+        assert resp.headers["X-Request-Id"] == tid
+        tracer = client.app["tracer"]
+        (trace,) = tracer.find(tid)
+        assert trace.finished and trace.error is True
+        assert all(s.end is not None for s in trace.spans)
+        assert tracer.inflight == 0
+
+
+async def test_trace_ring_bounded_under_sustained_chaos(
+    artifact_dir, monkeypatch
+):
+    monkeypatch.setenv("GORDO_TRACE_SAMPLE", "1")
+    monkeypatch.setenv("GORDO_TRACE_RING", "8")
+    monkeypatch.setenv("GORDO_TRACE_SLOW_KEEP", "4")
+    resilience.arm("bank.score", exc=FaultInjected)
+    async with _client(artifact_dir, quarantine_threshold=0) as client:
+        for i in range(30):
+            resp = await client.post(
+                "/gordo/v0/proj/chaos-a/prediction",
+                json=_x_payload(),
+                headers=_traceparent(f"{i:032x}"),
+            )
+            assert resp.status == 400
+        tracer = client.app["tracer"]
+        assert len(tracer.recent()) <= 8
+        assert len(tracer.slow()) <= 4
+        assert tracer.inflight == 0
+        # every retained trace closed all of its spans
+        for trace in tracer.recent() + tracer.slow():
+            assert trace.finished
+            assert all(s.end is not None for s in trace.spans)
+
+
+async def test_bucket_finalize_fault_keeps_tracing_on_fallback_path(
+    artifact_dir, monkeypatch
+):
+    """With bucket finalize tripped the models serve per-model; traces
+    must still complete there (device_execute span, no leaks)."""
+    monkeypatch.setenv("GORDO_TRACE_SAMPLE", "1")
+    resilience.arm("bank.finalize", times=1)
+    async with _client(artifact_dir) as client:
+        tid = "ef" * 16
+        resp = await client.post(
+            "/gordo/v0/proj/chaos-a/anomaly/prediction",
+            json=_x_payload(),
+            headers=_traceparent(tid),
+        )
+        assert resp.status == 200
+        tracer = client.app["tracer"]
+        (trace,) = tracer.find(tid)
+        assert trace.error is False
+        names = _flat_names(trace.summary()["spans"])
+        assert "device_execute" in names
+        assert tracer.inflight == 0
+
+
+# ------------------------------------------------------------------ #
 # watchman: scrape misses and snapshot refresh failures
 # ------------------------------------------------------------------ #
 
@@ -658,6 +749,7 @@ def test_checkpoint_read_fault_falls_back_to_fresh_start(tmp_path):
 # ------------------------------------------------------------------ #
 
 
+@pytest.mark.hotloop
 def test_disabled_faultpoints_within_5pct(bankable_models, monkeypatch):
     """``score_many`` with the real (disarmed) faultpoint vs a no-op stub
     in its place must be within 5% — catches accidental work creeping

@@ -392,7 +392,11 @@ async def test_fetch_json_honors_http_date_retry_after():
     async def handler(request):
         calls.append(_time.monotonic())
         if len(calls) == 1:
-            when = datetime.now(timezone.utc) + timedelta(seconds=1)
+            # +2s, not +1s: HTTP-dates have whole-second resolution, so a
+            # +1s hint can truncate to a sub-second wait (start at
+            # hh:mm:ss.9 and the formatted date is only 0.1s away) and
+            # flake the >=0.8s assertion below; +2s always parses >=1s
+            when = datetime.now(timezone.utc) + timedelta(seconds=2)
             raise web.HTTPServiceUnavailable(
                 headers={"Retry-After": format_datetime(when, usegmt=True)}
             )

@@ -412,6 +412,7 @@ async def test_server_generates_request_id_when_absent(sharded_artifact_dir):
 # ------------------------------------------------------------------ #
 
 
+@pytest.mark.hotloop
 def test_instrumented_hot_loop_within_5pct(bankable_models):
     """The instrumented serving hot loop (per-shard/per-bucket recording
     in ``score_many``) must stay within 5% of an uninstrumented control on

@@ -199,6 +199,96 @@ def test_aggregate_fleet_metrics_sums_max_and_skew():
     assert agg3["shard_skew_ratio"] == 1.5  # 30/20, not a negative-mean blowup
 
 
+def test_first_scrape_skew_math_emits_absent_not_nan():
+    """Regression (ISSUE 3 satellite): on the very first scrape — no
+    prior window, possibly zero traffic — the skew ratio and the
+    scrape-window math must emit 0/absent, never NaN or a
+    ZeroDivisionError, and the rendered rollup must carry no NaN skew
+    samples."""
+    # (a) no replicas at all (the first-scrape race on /metrics)
+    agg = aggregate_fleet_metrics([])
+    assert agg["replicas_scraped"] == 0
+    assert agg["shard_skew_ratio"] is None and agg["skew_window"] is None
+    text = render_fleet_metrics(agg)
+    assert "gordo_fleet_shard_skew_ratio" not in text
+    assert "NaN" not in text
+    # (b) replicas answering with ZERO-valued shard counters (a foreign
+    # or just-started server): mean is 0 -> no ratio, not a division
+    zero = (
+        'gordo_bank_shard_routed_rows_total{shard="0"} 0\n'
+        'gordo_bank_shard_routed_rows_total{shard="1"} 0\n'
+    )
+    agg = aggregate_fleet_metrics([zero, None])
+    assert agg["replicas_scraped"] == 1
+    assert agg["shard_skew_ratio"] is None and agg["skew_window"] is None
+    text = render_fleet_metrics(agg)
+    assert "gordo_fleet_shard_skew_ratio" not in text
+    assert "NaN" not in text
+    # the zero-valued rows DO render (0 is honest); only the ratio is
+    # absent
+    assert 'gordo_bank_shard_routed_rows_total{shard="0"} 0' in text
+    # (c) second scrape with a baseline but NO traffic since: all-zero
+    # deltas -> no skew signal, never 0/0
+    busy = (
+        'gordo_bank_shard_routed_rows_total{shard="0"} 40\n'
+        'gordo_bank_shard_routed_rows_total{shard="1"} 60\n'
+    )
+    agg1 = aggregate_fleet_metrics([busy])
+    agg2 = aggregate_fleet_metrics(
+        [busy], prev_shard_rows=agg1["replica_shard_rows"]
+    )
+    assert agg2["shard_skew_ratio"] is None and agg2["skew_window"] is None
+    assert "NaN" not in render_fleet_metrics(agg2)
+
+
+async def test_watchman_fleet_slow_traces_view(collection_dir, live_server):
+    """The fleet flight-recorder view: GET <watchman>/traces lists each
+    replica's worst recent traces plus the merged fleet-wide worst list
+    (replica index attached), and degrades per replica when a scrape
+    target is unreachable."""
+    async with live_server(collection_dir) as base_url:
+        # drive traffic so the server's slow reservoir has traces (the
+        # reservoir keeps worst-N regardless of head sampling, so the
+        # default sample rate works)
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            rng = np.random.RandomState(3)
+            for _ in range(3):
+                async with session.post(
+                    f"{base_url}/gordo/v0/proj/m-1/prediction",
+                    json={"X": rng.rand(16, 3).tolist()},
+                ) as resp:
+                    assert resp.status == 200
+        app = build_watchman_app(
+            "proj", base_url,
+            metrics_urls=[
+                f"{base_url}/gordo/v0/proj/metrics",
+                "http://127.0.0.1:1/gordo/v0/proj/metrics",  # dead replica
+            ],
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/traces?n=3")
+            assert resp.status == 200
+            body = await resp.json()
+            assert len(body["replicas"]) == 2
+            live, dead = body["replicas"]
+            assert live["scraped"] and live["tracing_enabled"]
+            assert live["traces"], "live replica must report its slow traces"
+            assert dead["scraped"] is False
+            assert body["worst"]
+            worst = body["worst"][0]
+            assert worst["replica"] == 0
+            assert worst["trace_id"] and worst["duration_ms"] > 0
+            # worst list is sorted slowest-first
+            durs = [w["duration_ms"] for w in body["worst"]]
+            assert durs == sorted(durs, reverse=True)
+        finally:
+            await client.close()
+
+
 async def test_watchman_fleet_metrics_rollup_live(collection_dir, live_server):
     """Watchman scrapes the collection server's /metrics and serves the
     fleet rollup on its own /metrics, plus a bounded summary in the root

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""trace-demo: a short serve loop with tracing on, then the top-3 slow
+traces with their per-stage breakdown (``make trace-demo``).
+
+Trains two tiny models into a temp dir, serves them through the real
+``build_app`` stack (bank + batching engine + tracing middleware) at
+``GORDO_TRACE_SAMPLE=1.0``, drives a mixed-latency load (small and large
+request bodies, plus one deliberately cold first request), and prints
+what the flight recorder kept — the operator's "where did the time go"
+workflow without a cluster. Pass ``--chrome out.json`` to also export
+the slow traces as Chrome trace-event JSON for chrome://tracing /
+Perfetto.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["GORDO_TRACE_SAMPLE"] = "1.0"
+
+import numpy as np  # noqa: E402
+
+
+def build_artifacts(root: str) -> None:
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.models import (
+        AutoEncoder,
+        DiffBasedAnomalyDetector,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(200, 3).astype("float32")
+    for i, name in enumerate(("demo-a", "demo-b")):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(epochs=1, batch_size=64)
+        )
+        det.fit(X + 0.01 * i)
+        serializer.dump(det, os.path.join(root, name), metadata={"name": name})
+
+
+def render_tree(node, indent=0, out=None):
+    out = out if out is not None else []
+    attrs = node.get("attributes") or {}
+    extra = ""
+    if attrs:
+        extra = "  [" + ", ".join(f"{k}={v}" for k, v in attrs.items()) + "]"
+    mark = " ERROR" if node.get("error") else ""
+    out.append(
+        f"{'  ' * indent}{node['name']:<16} "
+        f"{node['duration_ms']:>9.3f} ms{mark}{extra}"
+    )
+    for child in node.get("children", ()):
+        render_tree(child, indent + 1, out)
+    return out
+
+
+async def main(chrome_out=None, requests=40):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu.server import build_app
+
+    root = tempfile.mkdtemp(prefix="gordo-trace-demo-")
+    print(f"training 2 demo models into {root} ...", flush=True)
+    build_artifacts(root)
+
+    client = TestClient(TestServer(build_app(root)))
+    await client.start_server()
+    try:
+        rng = np.random.RandomState(1)
+        print(f"serving a mixed-latency loop ({requests} requests) ...", flush=True)
+        for i in range(requests):
+            name = ("demo-a", "demo-b")[i % 2]
+            rows = (16, 24, 96, 250)[i % 4]  # mixed sizes = mixed latency
+            resp = await client.post(
+                f"/gordo/v0/demo/{name}/anomaly/prediction",
+                json={"X": rng.rand(rows, 3).tolist()},
+            )
+            assert resp.status == 200, await resp.text()
+        body = await (await client.get("/gordo/v0/demo/traces/slow?n=3")).json()
+        print()
+        print("top-3 slow traces (flight recorder, slowest first):")
+        print("=" * 64)
+        for t in body["traces"]:
+            print(
+                f"trace {t['trace_id']}  rid={t['request_id']}  "
+                f"total {t['duration_ms']:.1f} ms"
+            )
+            print("\n".join(render_tree(t["spans"], indent=1)))
+            print("-" * 64)
+        if chrome_out:
+            doc = await (
+                await client.get("/gordo/v0/demo/traces/slow?format=chrome")
+            ).json()
+            with open(chrome_out, "w") as f:
+                json.dump(doc, f)
+            print(f"Chrome trace-event export -> {chrome_out} "
+                  "(open in chrome://tracing or https://ui.perfetto.dev)")
+    finally:
+        await client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--chrome", help="also write Chrome trace-event JSON here")
+    parser.add_argument("--requests", type=int, default=40)
+    args = parser.parse_args()
+    sys.exit(asyncio.run(main(chrome_out=args.chrome, requests=args.requests)))
