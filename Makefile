@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos hotloop trace-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline hotloop trace-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -22,6 +22,13 @@ test-fast:
 # (tests/test_chaos.py; the standing regression harness for robustness)
 chaos:
 	$(PYTHON) -m pytest tests/ -q -m chaos
+
+# deadline lane: latency faults + short request budgets through the
+# public HTTP API — proves expired requests 504 WITHOUT device dispatch,
+# the retry budget caps re-offers <1.1x, and hedges win against a slow
+# replica (tests/test_deadline.py)
+chaos-deadline:
+	$(PYTHON) -m pytest tests/test_deadline.py -q -m chaos
 
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a

@@ -12,16 +12,25 @@ import asyncio
 import hashlib
 import itertools
 import logging
+import random
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import aiohttp
 import pandas as pd
 
-from gordo_components_tpu.client.io import fetch_json, fetch_metadata_all
+from gordo_components_tpu.client.io import (
+    fetch_json,
+    fetch_json_hedged,
+    fetch_metadata_all,
+)
+from gordo_components_tpu.observability import get_registry
 from gordo_components_tpu.observability.tracing import format_traceparent
 from gordo_components_tpu.dataset import get_dataset
+from gordo_components_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from gordo_components_tpu.resilience.retry_budget import RetryBudget
 from gordo_components_tpu.server.utils import dict_to_frame
 from gordo_components_tpu.utils import parquet_engine_available
 
@@ -30,6 +39,30 @@ logger = logging.getLogger(__name__)
 # below this many targets, per-target /metadata GETs beat downloading the
 # whole fleet's metadata in one metadata-all response
 _PREFETCH_MIN_TARGETS = 8
+
+# latency samples needed before the hedge delay switches from the
+# configured initial value to the observed p95
+_HEDGE_MIN_SAMPLES = 16
+
+
+class _LatencyTracker:
+    """Bounded record of observed chunk latencies; p95 drives the hedge
+    delay so only the slowest ~5% of requests ever pay a duplicate."""
+
+    def __init__(self, maxlen: int = 256):
+        self._samples: "deque[float]" = deque(maxlen=maxlen)
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(seconds)
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def p95(self) -> Optional[float]:
+        if not self._samples:
+            return None
+        ordered = sorted(self._samples)
+        return ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
 
 
 @dataclass
@@ -62,14 +95,51 @@ class Client:
         use_anomaly: bool = True,
         metadata_fallback_dataset: Optional[Dict[str, Any]] = None,
         use_parquet="auto",
+        retries: int = 3,
+        backoff: float = 0.5,
+        retry_budget: Optional[RetryBudget] = None,
+        retry_budget_ratio: float = 0.1,
+        deadline_ms: Optional[float] = None,
+        hedge: bool = False,
+        replica_urls: Optional[List[str]] = None,
+        hedge_delay_init_s: float = 1.0,
     ):
         self.project = project
-        self.base_url = base_url or f"{scheme}://{host}:{port}"
+        # normalized (no trailing slash) so the hedge target exclusion
+        # compares like with like against replica_urls below
+        self.base_url = (base_url or f"{scheme}://{host}:{port}").rstrip("/")
         self.batch_size = int(batch_size)
         self.parallelism = int(parallelism)
         self.forwarder = forwarder
         self.use_anomaly = use_anomaly
         self.metadata_fallback_dataset = metadata_fallback_dataset
+        # transport citizenship knobs (previously hardcoded in io.py):
+        # bounded retries with decorrelated-jitter backoff, all gated by
+        # ONE shared token-bucket retry budget — a thousand chunks
+        # failing together can re-offer at most ~ratio x the offered
+        # load, not 3x (the synchronized-retry overload recipe)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.retry_budget = (
+            retry_budget
+            if retry_budget is not None
+            else RetryBudget(ratio=retry_budget_ratio)
+        )
+        # per-chunk time budget (ms), stamped on every scoring POST as
+        # X-Gordo-Deadline-Ms so a saturated server drops the work once
+        # this client has given up; also bounds the dataset build
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        # tail-latency hedging: after a p95-derived delay, re-issue a
+        # slow chunk POST to one other replica (from watchman's target
+        # list — see replicas_from_watchman) and take the first success
+        self.hedge = bool(hedge)
+        self.replica_urls = [
+            u.rstrip("/") for u in (replica_urls or []) if u.rstrip("/")
+        ]
+        self.hedge_delay_init_s = float(hedge_delay_init_s)
+        self._latency = _LatencyTracker()
+        self._hedge_stats: Dict[str, int] = {"hedges": 0, "hedge_wins": 0}
+        self._hedge_rng = random.Random()
         # request-body encoding for scoring POSTs: "auto" upgrades to
         # parquet when the server advertises it (JSON float-list
         # encode/decode dominates at fleet-backfill scale — the reference's
@@ -91,9 +161,91 @@ class Client:
         # traceable end to end (client log line <-> server histogram entry)
         self._rid_prefix = uuid.uuid4().hex[:12]
         self._rid_seq = itertools.count(1)
+        # after _rid_prefix: the metric series are labeled by it
+        self._register_metrics()
 
     def _next_request_id(self) -> str:
         return f"{self._rid_prefix}-{next(self._rid_seq):x}"
+
+    def _register_metrics(self) -> None:
+        """Read-through exposition of the client's overload-citizenship
+        counters in the process registry (the same cells bench snapshots
+        into BENCH_DETAIL.json). Weakref: the process registry must not
+        pin a discarded client. Series are labeled by the client's rid
+        prefix and registered under a per-instance key, so two clients
+        in one process (one per project, or a fresh client per run)
+        neither replace each other's collectors nor emit colliding
+        unlabeled samples; a discarded client's collector yields
+        nothing through the dead weakref."""
+        import weakref
+
+        ref = weakref.ref(self)
+        labels = {"client": self._rid_prefix}
+
+        def collect():
+            c = ref()
+            if c is None:
+                return
+            b = c.retry_budget.snapshot()
+            yield (
+                "gordo_client_retries_total", "counter",
+                "Retries the shared budget admitted", labels,
+                b["retries_allowed"],
+            )
+            yield (
+                "gordo_client_retries_denied_total", "counter",
+                "Retries refused because the budget was exhausted "
+                "(failed fast instead of re-offering load)", labels,
+                b["retries_denied"],
+            )
+            yield (
+                "gordo_client_retry_budget_tokens", "gauge",
+                "Retry tokens currently banked", labels, b["tokens"],
+            )
+            yield (
+                "gordo_client_hedges_total", "counter",
+                "Hedge requests issued (primary slower than the hedge "
+                "delay)", labels, c._hedge_stats["hedges"],
+            )
+            yield (
+                "gordo_client_hedge_wins_total", "counter",
+                "Hedged requests answered by the hedge replica first",
+                labels, c._hedge_stats["hedge_wins"],
+            )
+
+        get_registry().collector(collect, key=f"bulk_client:{self._rid_prefix}")
+
+    @staticmethod
+    def replicas_from_watchman(snapshot: Dict[str, Any]) -> List[str]:
+        """Replica base URLs from a watchman ``GET /`` snapshot body
+        (the ``replicas`` list watchman derives from its scrape
+        targets) — the hedging target list, fetched from the component
+        that already tracks which replicas exist."""
+        urls = snapshot.get("replicas") or []
+        return [u.rstrip("/") for u in urls if isinstance(u, str) and u]
+
+    def _hedge_delay_s(self) -> float:
+        """Hedge after the observed p95 (only the slowest ~5% of chunks
+        duplicate work); until enough samples exist, the configured
+        initial delay applies."""
+        if len(self._latency) >= _HEDGE_MIN_SAMPLES:
+            p95 = self._latency.p95()
+            if p95 is not None:
+                return max(p95, 1e-3)
+        return self.hedge_delay_init_s
+
+    def _chunk_urls(self, target: str, endpoint: str) -> List[str]:
+        """Primary URL plus (hedging only) ONE alternate replica's URL
+        for the same path."""
+        urls = [self._url(target, endpoint)]
+        if self.hedge:
+            others = [u for u in self.replica_urls if u != self.base_url]
+            if others:
+                alt = self._hedge_rng.choice(others)
+                urls.append(
+                    f"{alt}/gordo/v0/{self.project}/{target}/{endpoint}"
+                )
+        return urls
 
     @staticmethod
     def _trace_headers(rid: str) -> Dict[str, str]:
@@ -119,7 +271,13 @@ class Client:
         meta = self._metadata_all.get(target)
         if meta is not None:
             return meta
-        body = await fetch_json(session, self._url(target, "metadata"))
+        body = await fetch_json(
+            session,
+            self._url(target, "metadata"),
+            retries=self.retries,
+            backoff=self.backoff,
+            retry_budget=self.retry_budget,
+        )
         return body.get("endpoint-metadata", {})
 
     async def _prefetch_metadata(self, session) -> None:
@@ -184,7 +342,11 @@ class Client:
             if targets is None or self.use_parquet == "auto":
                 try:
                     models_body = await fetch_json(
-                        session, f"{self.base_url}/gordo/v0/{self.project}/models"
+                        session,
+                        f"{self.base_url}/gordo/v0/{self.project}/models",
+                        retries=self.retries,
+                        backoff=self.backoff,
+                        retry_budget=self.retry_budget,
                     )
                 except Exception:
                     if targets is None:  # discovery is mandatory
@@ -230,6 +392,7 @@ class Client:
         self, session, target, endpoint, chunk: pd.DataFrame,
         chunk_y: Optional[pd.DataFrame] = None,
         request_id: Optional[str] = None,
+        deadline: Optional[Deadline] = None,
     ):
         """POST one chunk as a parquet body (index rides inside the file,
         so timestamps round-trip without the JSON string lists). Target
@@ -247,12 +410,18 @@ class Client:
         headers = {"Content-Type": "application/x-parquet"}
         if request_id:
             headers.update(self._trace_headers(request_id))
-        return await fetch_json(
+        return await fetch_json_hedged(
             session,
-            self._url(target, endpoint),
+            self._chunk_urls(target, endpoint),
+            hedge_delay_s=self._hedge_delay_s(),
+            hedge_stats=self._hedge_stats,
             method="POST",
             data=buf.getvalue(),
             headers=headers,
+            retries=self.retries,
+            backoff=self.backoff,
+            retry_budget=self.retry_budget,
+            deadline=deadline,
         )
 
     async def _predict_single(
@@ -262,9 +431,37 @@ class Client:
             meta = await self._get_metadata(session, target)
             config = self._dataset_config_from_metadata(meta, start, end)
             dataset = get_dataset(config)
-            X, y = await asyncio.get_running_loop().run_in_executor(
+        except Exception as exc:
+            logger.exception("Failed to resolve dataset config for %s", target)
+            return PredictionResult(target, None, [f"dataset: {exc}"])
+        try:
+            fetch = asyncio.get_running_loop().run_in_executor(
                 None, dataset.get_data
             )
+            if self.deadline_ms is not None:
+                # a hung data provider must not stall a backfill slot
+                # forever: the dataset build gets the same budget as a
+                # chunk POST (the executor job itself can't be
+                # interrupted, but the slot moves on and reports).
+                # Deliberately its OWN try block: a metadata-fetch
+                # timeout above must not land in this handler
+                fetch = asyncio.wait_for(fetch, timeout=self.deadline_ms / 1e3)
+                try:
+                    X, y = await fetch
+                except asyncio.TimeoutError:
+                    logger.error(
+                        "Dataset build for %s exceeded the %.0fms deadline",
+                        target, self.deadline_ms,
+                    )
+                    return PredictionResult(
+                        target, None,
+                        [
+                            f"dataset: build exceeded "
+                            f"{self.deadline_ms:.0f}ms deadline"
+                        ],
+                    )
+            else:
+                X, y = await fetch
         except Exception as exc:
             logger.exception("Failed to build dataset for %s", target)
             return PredictionResult(target, None, [f"dataset: {exc}"])
@@ -276,15 +473,27 @@ class Client:
         async def post_chunk(chunk: pd.DataFrame, chunk_y: Optional[pd.DataFrame]):
             async with sem:
                 # one id per chunk, reused across the parquet->JSON
-                # downgrade re-post: both attempts are the SAME request
+                # downgrade re-post: both attempts are the SAME request.
+                # Likewise ONE deadline: the downgrade re-post spends
+                # what remains of the chunk's budget, not a fresh one.
                 rid = self._next_request_id()
+                deadline = (
+                    Deadline.after_ms(self.deadline_ms)
+                    if self.deadline_ms is not None
+                    else None
+                )
+                t0 = asyncio.get_running_loop().time()
                 parquet_exc = None
                 if self._parquet_active:
                     try:
-                        return await self._post_parquet(
+                        body = await self._post_parquet(
                             session, target, endpoint, chunk, chunk_y,
-                            request_id=rid,
+                            request_id=rid, deadline=deadline,
                         )
+                        self._latency.record(
+                            asyncio.get_running_loop().time() - t0
+                        )
+                        return body
                     except ValueError as exc:
                         # 4xx on the parquet body. Ambiguous: the server
                         # may reject the ENCODING (foreign pod, no parse
@@ -306,13 +515,25 @@ class Client:
                 if chunk_y is not None:
                     payload["y"] = chunk_y.values.tolist()
                 try:
-                    body = await fetch_json(
+                    body = await fetch_json_hedged(
                         session,
-                        self._url(target, endpoint),
+                        self._chunk_urls(target, endpoint),
+                        hedge_delay_s=self._hedge_delay_s(),
+                        hedge_stats=self._hedge_stats,
                         method="POST",
                         json_payload=payload,
                         headers=self._trace_headers(rid),
+                        retries=self.retries,
+                        backoff=self.backoff,
+                        retry_budget=self.retry_budget,
+                        deadline=deadline,
                     )
+                    self._latency.record(asyncio.get_running_loop().time() - t0)
+                except DeadlineExceeded as exc:
+                    errors.append(
+                        f"chunk {chunk.index[0]} (rid={rid}): deadline: {exc}"
+                    )
+                    return None
                 except Exception as exc:
                     errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
                     return None

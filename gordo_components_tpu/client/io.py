@@ -1,14 +1,41 @@
 """Async HTTP helpers for the bulk client.
 
 Reference parity: gordo_components/client/io.py (unverified; SURVEY.md §2
-"client") — bounded-concurrency POSTs with retry/backoff.
+"client") — bounded-concurrency POSTs with retry/backoff. Grown into the
+client half of the overload defense (resilience/):
+
+- retries sleep on DECORRELATED JITTER, not ``backoff * 2**attempt`` —
+  deterministic exponential backoff synchronizes chunks that failed
+  together, so every retry wave re-creates the overload it backed off
+  from (the metastable-overload recipe);
+- a shared :class:`~gordo_components_tpu.resilience.retry_budget.RetryBudget`
+  token bucket gates every retry, capping a client's re-offered load at
+  ``1 + ratio`` times its offered load by arithmetic;
+- per-request :class:`~gordo_components_tpu.resilience.deadline.Deadline`
+  budgets are stamped onto the wire (``X-Gordo-Deadline-Ms``) so the
+  server can drop the request once the client stops waiting, and bound
+  each attempt locally;
+- :func:`fetch_json_hedged` trades a bounded amount of duplicate work
+  for tail latency: after a (p95-derived) delay, re-issue the request to
+  a second replica and take the first success.
 """
 
 import asyncio
 import logging
-from typing import Any, Dict, Optional
+import random
+from typing import Any, Dict, List, Optional
 
 import aiohttp
+
+from gordo_components_tpu.resilience.deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+)
+from gordo_components_tpu.resilience.retry_budget import (
+    RetryBudget,
+    decorrelated_jitter,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -80,9 +107,13 @@ async def fetch_metadata_all(
             return await resp.json()
 
     try:
-        body = await asyncio.wait_for(get(), timeout=deadline)
+        # shared deadline helper (resilience/deadline.py): the same
+        # bound watchman's scrape/refresh paths use, so every
+        # control-plane "give up after" expires identically
+        body = await Deadline(deadline).wait_for(get())
     except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
-        # ValueError covers json.JSONDecodeError on a malformed 200
+        # ValueError covers json.JSONDecodeError on a malformed 200;
+        # DeadlineExceeded subclasses asyncio.TimeoutError
         logger.debug("metadata-all fetch failed: %s", exc)
         return None
     if not isinstance(body, dict) or not isinstance(body.get("targets"), dict):
@@ -101,46 +132,107 @@ async def fetch_json(
     headers: Optional[Dict[str, str]] = None,
     retries: int = 3,
     backoff: float = 0.5,
+    backoff_cap: float = 60.0,
+    retry_budget: Optional[RetryBudget] = None,
+    deadline: Optional[Deadline] = None,
+    rng: Optional[random.Random] = None,
 ) -> Dict[str, Any]:
     """GET/POST returning parsed JSON, with bounded retry on transient
     failures; 4xx (except 408/429) are not retried. ``data`` posts a raw
     body (e.g. parquet bytes) with ``headers`` carrying its content type;
-    mutually exclusive with ``json_payload``."""
+    mutually exclusive with ``json_payload``.
+
+    Retry sleeps use decorrelated jitter (never the synchronized
+    ``backoff * 2**attempt`` schedule), a server's ``Retry-After`` drain
+    estimate still takes precedence as a lower bound, and two optional
+    citizenship controls gate the loop:
+
+    - ``retry_budget`` — a shared token bucket
+      (:class:`~gordo_components_tpu.resilience.retry_budget.RetryBudget`);
+      when it refuses a token the last error raises immediately (fail
+      fast: the fleet is already saturated with first-offer load).
+    - ``deadline`` — the request's remaining budget: stamped on the wire
+      as ``X-Gordo-Deadline-Ms`` (recomputed per attempt so the server
+      sees the budget LEFT, not the original), bounding each attempt
+      locally, and ending the retry loop once expired.
+
+    ``rng`` pins the jitter stream for deterministic tests.
+    """
     if json_payload is not None and data is not None:
         raise ValueError("pass json_payload or data, not both")
+    # retries counts TOTAL attempts; clamp so retries=0 ("no retries")
+    # still sends the one first offer instead of raising a bare None
+    retries = max(1, int(retries))
+    if retry_budget is not None:
+        retry_budget.note_request()
+
+    async def attempt_once() -> Dict[str, Any]:
+        send_headers = headers
+        if deadline is not None:
+            # per-attempt restamp: the server must see the remaining
+            # budget, not the original — a retry arriving with 50ms left
+            # of a 2000ms budget must not be queued as if it had 2000ms
+            send_headers = dict(headers or {})
+            send_headers[DEADLINE_HEADER] = str(
+                max(1, int(deadline.remaining_ms()))
+            )
+        async with session.request(
+            method, url, json=json_payload, data=data, headers=send_headers
+        ) as resp:
+            if resp.status == 422:
+                raise HttpUnprocessableEntity(await resp.text())
+            if resp.status in (408, 429) or resp.status >= 500:
+                raise aiohttp.ClientResponseError(
+                    resp.request_info,
+                    resp.history,
+                    status=resp.status,
+                    message=await resp.text(),
+                    headers=resp.headers,  # carries Retry-After on 429
+                )
+            if resp.status >= 400:
+                body = await resp.text()
+                raise ValueError(f"HTTP {resp.status} from {url}: {body[:500]}")
+            return await resp.json()
+
     last_exc: Optional[Exception] = None
+    prev_delay = backoff
     for attempt in range(retries):
         try:
-            async with session.request(
-                method, url, json=json_payload, data=data, headers=headers
-            ) as resp:
-                if resp.status == 422:
-                    raise HttpUnprocessableEntity(await resp.text())
-                if resp.status in (408, 429) or resp.status >= 500:
-                    raise aiohttp.ClientResponseError(
-                        resp.request_info,
-                        resp.history,
-                        status=resp.status,
-                        message=await resp.text(),
-                        headers=resp.headers,  # carries Retry-After on 429
+            if deadline is not None:
+                if deadline.expired():
+                    raise DeadlineExceeded(
+                        f"deadline expired before attempt {attempt + 1} "
+                        f"to {url}"
                     )
-                if resp.status >= 400:
-                    body = await resp.text()
-                    raise ValueError(f"HTTP {resp.status} from {url}: {body[:500]}")
-                return await resp.json()
+                return await deadline.wait_for(attempt_once())
+            return await attempt_once()
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             last_exc = exc
             if attempt + 1 >= retries:
                 break  # no retry left: sleeping first would only delay the error
-            delay = backoff * (2**attempt)
+            if deadline is not None and deadline.expired():
+                break  # out of time: a retry would expire server-side anyway
+            if retry_budget is not None and not retry_budget.try_spend():
+                logger.warning(
+                    "Request %s %s failed (%s); retry budget exhausted — "
+                    "failing fast instead of re-offering load",
+                    method, url, exc,
+                )
+                break
+            # decorrelated jitter: chunks that failed together must NOT
+            # retry together (a deterministic schedule re-creates the
+            # overload it backed off from, wave after wave)
+            delay = prev_delay = decorrelated_jitter(
+                backoff, prev_delay, cap=backoff_cap, rng=rng
+            )
             # a shedding server's Retry-After is its queue-drain estimate
             # (server/bank.py EngineOverloaded): honoring it beats blind
-            # exponential backoff — the fleet-backfill storm re-offers
-            # load right when capacity frees instead of too early (more
-            # sheds) or too late (idle server). Both header forms parse
+            # backoff — the fleet-backfill storm re-offers load right
+            # when capacity frees instead of too early (more sheds) or
+            # too late (idle server). Both header forms parse
             # (delta-seconds and HTTP-date — proxies send the latter).
-            # Clamped: the value is server/proxy-controlled, and a huge or
-            # inf value must not hang the backfill
+            # Clamped: the value is server/proxy-controlled, and a huge
+            # or inf value must not hang the backfill
             if (
                 isinstance(exc, aiohttp.ClientResponseError)
                 and exc.headers is not None
@@ -149,9 +241,85 @@ async def fetch_json(
                 hinted = retry_after_seconds(exc.headers["Retry-After"])
                 if hinted is not None:
                     delay = max(delay, min(hinted, 60.0))
+            if deadline is not None:
+                # never sleep past our own expiry: a dead chunk holding
+                # its concurrency slot through a 30s Retry-After nap is
+                # capacity stolen from chunks that could still succeed
+                delay = min(delay, deadline.remaining_s())
             logger.warning(
                 "Request %s %s failed (%s); retry %d/%d in %.1fs",
                 method, url, exc, attempt + 1, retries, delay,
             )
             await asyncio.sleep(delay)
     raise last_exc  # type: ignore[misc]
+
+
+async def fetch_json_hedged(
+    session: aiohttp.ClientSession,
+    urls: List[str],
+    *,
+    hedge_delay_s: float,
+    hedge_stats: Optional[Dict[str, int]] = None,
+    **kwargs: Any,
+) -> Dict[str, Any]:
+    """Tail-latency hedging: issue the request to ``urls[0]``; if it
+    hasn't answered within ``hedge_delay_s`` (derive it from the
+    observed p95 so only the slowest ~5% of requests hedge), issue ONE
+    duplicate to ``urls[1]`` and return the first success, cancelling
+    the loser. A single-entry ``urls`` degrades to plain
+    :func:`fetch_json`.
+
+    ``hedge_stats`` (optional dict) gets ``hedges``/``hedge_wins``
+    incremented — the bulk client exposes them as
+    ``gordo_client_hedges_total``/``gordo_client_hedge_wins_total``.
+    Both failing raises the PRIMARY's error (the hedge is an
+    optimization; its replica's failure mode is secondary information,
+    logged at DEBUG).
+    """
+    if len(urls) < 2:
+        return await fetch_json(session, urls[0], **kwargs)
+    primary = asyncio.ensure_future(fetch_json(session, urls[0], **kwargs))
+    try:
+        return await asyncio.wait_for(asyncio.shield(primary), hedge_delay_s)
+    except asyncio.TimeoutError:
+        pass  # primary still in flight: hedge it
+    except BaseException:
+        # primary FAILED fast (an error, not slowness) — or the CALLER
+        # was cancelled mid-wait: either way the shielded task must not
+        # keep running unawaited against the server
+        primary.cancel()
+        raise
+    if hedge_stats is not None:
+        hedge_stats["hedges"] = hedge_stats.get("hedges", 0) + 1
+    # the hedge is a ONE-shot rescue: no internal retries, and no
+    # note_request deposit into the shared budget — a hedge is extra
+    # offered load, and letting it earn retry tokens would quietly
+    # loosen the documented 1+ratio re-offer cap exactly in the
+    # high-hedge-rate overload regime the budget protects against
+    hedge_kwargs = {**kwargs, "retries": 1, "retry_budget": None}
+    hedge = asyncio.ensure_future(fetch_json(session, urls[1], **hedge_kwargs))
+    pending = {primary, hedge}
+    first_exc: Optional[BaseException] = None
+    try:
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                exc = task.exception()
+                if exc is None:
+                    if task is hedge and hedge_stats is not None:
+                        hedge_stats["hedge_wins"] = (
+                            hedge_stats.get("hedge_wins", 0) + 1
+                        )
+                    return task.result()
+                if task is primary:
+                    first_exc = exc
+                else:
+                    logger.debug("hedge request to %s failed: %s", urls[1], exc)
+                    if first_exc is None:
+                        first_exc = exc
+    finally:
+        for task in pending:  # cancel the loser
+            task.cancel()
+    raise first_exc  # type: ignore[misc]  # both failed

@@ -21,8 +21,22 @@ only real if they can be *exercised*: this package provides
   evicted from routing (410 with a reason instead of a crash-retry
   loop) while the rest of the collection keeps serving; the server's
   tri-state ``/healthz`` reports ``degraded`` instead of flapping.
+- :mod:`deadline` — per-request time budgets (``X-Gordo-Deadline-Ms``
+  header -> :class:`Deadline` -> engine/bank drop-before-dispatch ->
+  HTTP 504 :class:`DeadlineExceeded`), plus the shared
+  ``Deadline.wait_for`` bound watchman's scrape/refresh paths reuse.
+- :mod:`retry_budget` — :class:`RetryBudget` (token-bucket cap on
+  client re-offered load) and decorrelated-jitter backoff, the client
+  half of the overload defense.
 """
 
+from gordo_components_tpu.resilience.deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    default_deadline_ms,
+    parse_deadline_ms,
+)
 from gordo_components_tpu.resilience.faults import (
     FaultInjected,
     FaultSpec,
@@ -35,16 +49,27 @@ from gordo_components_tpu.resilience.faults import (
     reset,
 )
 from gordo_components_tpu.resilience.quarantine import QuarantineSet
+from gordo_components_tpu.resilience.retry_budget import (
+    RetryBudget,
+    decorrelated_jitter,
+)
 
 __all__ = [
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
     "FaultInjected",
     "FaultSpec",
     "QuarantineSet",
+    "RetryBudget",
     "arm",
     "configure_from_env",
+    "decorrelated_jitter",
+    "default_deadline_ms",
     "disarm",
     "fault_stats",
     "faultpoint",
+    "parse_deadline_ms",
     "registered_sites",
     "reset",
 ]

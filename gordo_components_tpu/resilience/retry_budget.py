@@ -1,0 +1,113 @@
+"""Client-side retry budget + decorrelated-jitter backoff.
+
+Two halves of the same overload defense (the client-side complement to
+the server's enqueue-time shedding and deadline drops):
+
+- :class:`RetryBudget` — a token bucket bounding how much EXTRA load a
+  retrying client may add (the gRPC/Finagle "retry budget" design).
+  Every first attempt deposits ``ratio`` tokens; every retry withdraws
+  one whole token. With ``ratio=0.1`` a client can re-offer at most
+  ~10% of its offered load no matter how the fleet is failing —
+  arithmetic, not configuration discipline, caps the retry storm below
+  1.1x. The budget is SHARED across a client's concurrent chunks: the
+  whole backfill run gets one bucket, so a thousand chunks failing
+  together cannot each claim their private 3 retries.
+
+- :func:`decorrelated_jitter` — the backoff schedule that replaces the
+  deterministic ``backoff * 2**attempt``. Deterministic exponential
+  backoff SYNCHRONIZES: chunks that failed together (one shed burst,
+  one replica restart) sleep the same time and re-arrive together,
+  re-creating the overload they backed off from, forever. Decorrelated
+  jitter (`sleep = uniform(base, prev * 3)`, capped) spreads each
+  retry wave thinner than the last (the AWS architecture-blog result).
+"""
+
+import random
+import threading
+from typing import Dict, Optional
+
+__all__ = ["RetryBudget", "decorrelated_jitter"]
+
+
+def decorrelated_jitter(
+    base: float,
+    prev: float,
+    cap: float = 60.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Next sleep in a decorrelated-jitter schedule.
+
+    ``base`` is the configured backoff floor, ``prev`` the previous
+    sleep (pass ``base`` on the first retry). Grows in EXPECTATION like
+    exponential backoff but two clients never share a schedule.
+    """
+    r = rng.uniform if rng is not None else random.uniform
+    return min(max(0.0, cap), r(base, max(base, prev * 3.0)))
+
+
+class RetryBudget:
+    """Token-bucket retry admission shared across concurrent requests.
+
+    ``note_request()`` (called once per logical request) deposits
+    ``ratio`` tokens; ``try_spend()`` withdraws one token per retry and
+    answers whether the retry is allowed. ``initial`` pre-fills the
+    bucket so a small burst of early failures can still retry before
+    any deposits accumulate; ``max_tokens`` bounds how much unused
+    budget can bank up (a quiet hour must not fund a retry storm
+    later). Thread-safe: the bulk client records from the event loop
+    but the lock keeps the type safe for executor use too.
+    """
+
+    def __init__(
+        self,
+        ratio: float = 0.1,
+        initial: float = 10.0,
+        max_tokens: float = 100.0,
+    ):
+        if ratio < 0:
+            raise ValueError(f"ratio must be >= 0, got {ratio!r}")
+        if max_tokens <= 0:
+            raise ValueError(f"max_tokens must be positive, got {max_tokens!r}")
+        self.ratio = float(ratio)
+        self.max_tokens = float(max_tokens)
+        self.tokens = min(float(initial), self.max_tokens)
+        self.requests = 0
+        self.allowed = 0  # retries the budget admitted
+        self.denied = 0  # retries the budget refused
+        self._lock = threading.Lock()
+
+    def note_request(self) -> None:
+        """One logical request offered: deposit the earned retry
+        fraction."""
+        with self._lock:
+            self.requests += 1
+            self.tokens = min(self.max_tokens, self.tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        """Withdraw one retry token; False = budget exhausted, do NOT
+        retry (fail fast — the fleet is already saturated with the
+        first-offer load)."""
+        with self._lock:
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                self.allowed += 1
+                return True
+            self.denied += 1
+            return False
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "tokens": round(self.tokens, 3),
+                "ratio": self.ratio,
+                "requests": self.requests,
+                "retries_allowed": self.allowed,
+                "retries_denied": self.denied,
+            }
+
+    def __repr__(self) -> str:
+        s = self.snapshot()
+        return (
+            f"<RetryBudget tokens={s['tokens']} ratio={s['ratio']} "
+            f"allowed={s['retries_allowed']} denied={s['retries_denied']}>"
+        )

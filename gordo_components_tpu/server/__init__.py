@@ -19,6 +19,12 @@ from aiohttp import web
 from gordo_components_tpu.observability import MetricsRegistry, Tracer
 from gordo_components_tpu.observability.tracing import format_traceparent
 from gordo_components_tpu.resilience import QuarantineSet, configure_from_env
+from gordo_components_tpu.resilience.deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    default_deadline_ms,
+    parse_deadline_ms,
+)
 from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 from gordo_components_tpu.server.model_io import ModelCollection
 from gordo_components_tpu.server.stats import LatencyHistogram
@@ -79,6 +85,19 @@ async def _stats_middleware(request, handler):
         f"srv-{next(_RID_SEQ):x}"
     )
     request["request_id"] = rid
+    # per-request time budget (resilience/deadline.py): the client's
+    # X-Gordo-Deadline-Ms header, or the operator default
+    # (GORDO_DEFAULT_DEADLINE_MS, resolved once at build_app). The
+    # engine drops entries whose deadline passes before device dispatch
+    # (504). No header + no default is the common case and costs one
+    # dict read — held to the <=5% hotloop guard in tests/test_deadline.py
+    raw_deadline = request.headers.get(DEADLINE_HEADER)
+    deadline_ms = parse_deadline_ms(raw_deadline) if raw_deadline else None
+    if deadline_ms is None:
+        deadline_ms = request.app.get("default_deadline_ms")
+    request["deadline"] = (
+        Deadline.after_ms(deadline_ms) if deadline_ms else None
+    )
     tracer = request.app.get("tracer")
     trace = None
     if tracer is not None:
@@ -305,6 +324,10 @@ def build_app(
         "latency": {},
         "exemplars": {},
     }
+    # operator default request budget (ms): applied by the middleware to
+    # every request that carries no X-Gordo-Deadline-Ms header; None
+    # (unset) keeps the pre-deadline behavior of never expiring
+    app["default_deadline_ms"] = default_deadline_ms()
     # per-app request tracer (observability/tracing.py): the middleware
     # opens a trace per request, the engine/bank record stage spans into
     # it, and ``GET .../traces`` serves the ring + slow reservoir.

@@ -20,7 +20,7 @@ import json
 import logging
 import math
 import time
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 import pandas as pd
@@ -28,6 +28,7 @@ from aiohttp import web
 
 from gordo_components_tpu import __version__, serializer
 from gordo_components_tpu.observability.tracing import chrome_trace
+from gordo_components_tpu.resilience.deadline import DeadlineExceeded
 from gordo_components_tpu.server.bank import EngineOverloaded
 from gordo_components_tpu.server.utils import extract_x_y, frame_to_dict
 from gordo_components_tpu.utils import parquet_engine_available
@@ -111,9 +112,13 @@ def _note_scoring_result(request: web.Request, target: str, X, values) -> None:
 def _note_scoring_error(request: web.Request, target: str, exc: Exception) -> None:
     """Count a scoring exception against the quarantine breaker.
     Input-shape complaints (ValueError/KeyError) are the request's fault,
-    not the model's, and never count."""
+    not the model's, and a blown deadline is the clock's — neither ever
+    counts (expired requests are handled before this is reached; the
+    exclusion is belt-and-braces for future call sites)."""
     quarantine = request.app.get("quarantine")
-    if quarantine is None or isinstance(exc, (ValueError, KeyError)):
+    if quarantine is None or isinstance(
+        exc, (ValueError, KeyError, DeadlineExceeded)
+    ):
         return
     quarantine.record_failure(target, f"{type(exc).__name__}: {exc}")
 
@@ -126,6 +131,46 @@ def _http_overloaded(exc: EngineOverloaded) -> web.HTTPTooManyRequests:
         ),
         content_type="application/json",
         headers={"Retry-After": str(max(1, math.ceil(exc.retry_after_s)))},
+    )
+
+
+def _note_deadline_expired_per_model(request: web.Request) -> None:
+    """Observability for a per-model-path expiry (engine expiries count
+    themselves): bump the engine's counter when one exists — a bank
+    server's non-banked targets share the same
+    ``gordo_engine_deadline_expired_total`` series the 504 runbook
+    alerts on — and record the ``deadline_expired`` span."""
+    engine = request.app.get("bank_engine")
+    if engine is not None:
+        engine.stats["deadline_expired"] += 1
+    trace = request.get("trace")
+    if trace is not None:
+        now = time.monotonic()
+        trace.add_span(
+            "deadline_expired", now, now, error=True, where="per-model"
+        )
+
+
+def _http_deadline_exceeded(
+    request: web.Request, exc: Optional[DeadlineExceeded] = None
+) -> web.HTTPGatewayTimeout:
+    """504 for a request whose time budget ran out before (or during)
+    scoring. The body names the request id — the ONE request a client
+    most wants to correlate is the one it already gave up on — and the
+    middleware stamps the usual X-Request-Id/traceparent echo on the
+    HTTPException headers, matching the 500/410 paths. Retrying an
+    expired request verbatim is pointless (the same budget expires the
+    same way), so unlike the 429 there is no Retry-After hint: raise
+    the deadline or shed load instead."""
+    rid = request.get("request_id")
+    return web.HTTPGatewayTimeout(
+        text=json.dumps(
+            {
+                "error": str(exc) if exc is not None else "deadline exceeded",
+                "request_id": rid,
+            }
+        ),
+        content_type="application/json",
     )
 
 
@@ -590,6 +635,7 @@ async def prediction(request: web.Request) -> web.Response:
         )
     engine = _bank_engine(request)
     trace = request.get("trace")
+    deadline = request.get("deadline")
     try:
         if engine is not None:
             result = await engine.score(
@@ -597,9 +643,15 @@ async def prediction(request: web.Request) -> web.Response:
                 X.values.astype("float32"),
                 request_id=request.get("request_id"),
                 trace=trace,
+                deadline=deadline,
             )
             output = result.model_output
         else:
+            if deadline is not None and deadline.expired():
+                # per-model path: the executor job can't be cancelled
+                # once submitted, so the expiry check runs before it
+                _note_deadline_expired_per_model(request)
+                raise DeadlineExceeded("deadline expired before dispatch")
             loop = asyncio.get_running_loop()
             t0 = time.monotonic()
             output = await loop.run_in_executor(
@@ -613,6 +665,10 @@ async def prediction(request: web.Request) -> web.Response:
                 )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
+    except DeadlineExceeded as exc:
+        # NOT a scoring error: the model is healthy, the clock ran out —
+        # never counted against the quarantine breaker
+        raise _http_deadline_exceeded(request, exc)
     except Exception as exc:  # surface model errors as 400s with detail
         _note_scoring_error(request, target, exc)
         logger.exception("prediction failed")
@@ -648,6 +704,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         )
     engine = _bank_engine(request)
     trace = request.get("trace")
+    deadline = request.get("deadline")
     try:
         if engine is not None:
             result = await engine.score(
@@ -656,12 +713,16 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 None if y is None else y.values.astype("float32"),
                 request_id=request.get("request_id"),
                 trace=trace,
+                deadline=deadline,
             )
             t0 = time.monotonic()
             frame = result.to_frame(index=X.index)
             if trace is not None:
                 trace.add_span("postprocess", t0, time.monotonic(), stage="to_frame")
         else:
+            if deadline is not None and deadline.expired():
+                _note_deadline_expired_per_model(request)
+                raise DeadlineExceeded("deadline expired before dispatch")
             loop = asyncio.get_running_loop()
             t0 = time.monotonic()
             frame = await loop.run_in_executor(None, model.anomaly, X, y)
@@ -671,6 +732,8 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
+    except DeadlineExceeded as exc:
+        raise _http_deadline_exceeded(request, exc)
     except Exception as exc:
         _note_scoring_error(request, target, exc)
         logger.exception("anomaly scoring failed")

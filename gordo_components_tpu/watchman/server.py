@@ -26,6 +26,7 @@ from aiohttp import web
 
 from gordo_components_tpu import __version__
 from gordo_components_tpu.observability import parse_prometheus_text, render_samples
+from gordo_components_tpu.resilience.deadline import Deadline
 from gordo_components_tpu.resilience.faults import faultpoint
 
 logger = logging.getLogger(__name__)
@@ -330,7 +331,10 @@ class WatchmanState:
                 return await resp.json()
 
         try:
-            body = await asyncio.wait_for(get(), timeout=10.0)
+            # shared deadline helper (resilience/deadline.py) — the same
+            # bound the client transport uses; DeadlineExceeded
+            # subclasses asyncio.TimeoutError so the catch stays one line
+            body = await Deadline(10.0).wait_for(get())
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
             logger.debug("stats fetch failed: %s", exc)
             return None
@@ -380,7 +384,7 @@ class WatchmanState:
 
                     try:
                         _FP_SCRAPE.fire()
-                        return await asyncio.wait_for(get(), timeout=10.0)
+                        return await Deadline(10.0).wait_for(get())
                     except asyncio.CancelledError:
                         raise
                     except Exception as exc:
@@ -436,6 +440,11 @@ class WatchmanState:
     def _trace_urls(self) -> List[str]:
         """Per-replica slow-trace endpoints, derived from the metrics
         scrape targets (same replica set, sibling path)."""
+        return [u + "/traces/slow" for u in self._replica_prefixes()]
+
+    def _replica_prefixes(self) -> List[str]:
+        """Per-replica ``.../gordo/v0/<project>`` prefixes, derived from
+        the metrics scrape targets (the authoritative replica set)."""
         urls = self.metrics_urls or [
             f"{self.base_url}/gordo/v0/{self.project}/metrics"
         ]
@@ -445,7 +454,21 @@ class WatchmanState:
             u = u.rstrip("/")  # tolerate a trailing slash on the target
             if u.endswith(suffix):
                 u = u[: -len(suffix)]
-            out.append(u + "/traces/slow")
+            out.append(u)
+        return out
+
+    def replica_base_urls(self) -> List[str]:
+        """Replica BASE URLs (scheme://host:port), served in the health
+        snapshot as the fleet's target list — the bulk client's hedging
+        mode picks its second replica from exactly this list
+        (``Client.replicas_from_watchman``), so "which replicas exist"
+        has one owner."""
+        marker = "/gordo/v0/"
+        out: List[str] = []
+        for u in self._replica_prefixes():
+            base = u.split(marker, 1)[0] if marker in u else u
+            if base and base not in out:
+                out.append(base)
         return out
 
     async def fleet_slow_traces(self, per_replica: int = 5) -> Dict[str, Any]:
@@ -470,7 +493,7 @@ class WatchmanState:
                         return await resp.json()
 
                 try:
-                    return await asyncio.wait_for(get(), timeout=10.0)
+                    return await Deadline(10.0).wait_for(get())
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
@@ -589,7 +612,7 @@ class WatchmanState:
 
                 if deadline is None:
                     return await get()
-                return await asyncio.wait_for(get(), timeout=deadline)
+                return await Deadline(deadline).wait_for(get())
 
             bank = None
             targets = self.targets
@@ -716,6 +739,9 @@ def build_watchman_app(
 
     async def root(request: web.Request) -> web.Response:
         body = dict(await state.snapshot())  # copy: the cache must stay clean
+        # the fleet's replica target list (derived from the metrics
+        # scrape config): hedging clients pick their second replica here
+        body["replicas"] = state.replica_base_urls()
         # bounded fleet-metrics summary rides along so one snapshot answers
         # both "is the fleet healthy" and "is any shard hot anywhere".
         # wait=False: the health path must not inherit a hung replica's

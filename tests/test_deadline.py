@@ -1,0 +1,814 @@
+"""End-to-end deadline propagation, client retry budgets, and hedging
+(resilience/deadline.py, resilience/retry_budget.py).
+
+The acceptance story this file proves (ISSUE 4): under an injected
+latency fault with a short client deadline, expired requests return 504
+*without* device dispatch (``gordo_engine_deadline_expired_total``
+rises, no ``device_execute`` span), the shared retry budget caps client
+re-offers below 1.1x offered load, and a hedged request against a
+slow/fast replica pair returns the fast replica's answer.
+"""
+
+import asyncio
+import random
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import resilience, serializer
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+from gordo_components_tpu.observability.tracing import Tracer
+from gordo_components_tpu.resilience import RetryBudget, decorrelated_jitter
+from gordo_components_tpu.resilience.deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    default_deadline_ms,
+    parse_deadline_ms,
+)
+from gordo_components_tpu.server import build_app
+from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+@pytest.fixture(scope="module")
+def bankable_models():
+    rng = np.random.RandomState(0)
+    X3 = rng.rand(160, 3).astype("float32")
+    models = {}
+    for i, name in enumerate(("dl-a", "dl-b")):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(epochs=1, batch_size=64)
+        )
+        det.fit(X3 + 0.01 * i)
+        models[name] = det
+    return models
+
+
+@pytest.fixture(scope="module")
+def two_bucket_models(bankable_models):
+    """Two models in DIFFERENT buckets (feature counts 3 vs 2), so a
+    score_many call spans two bucket-group dispatches."""
+    rng = np.random.RandomState(1)
+    det2 = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(epochs=1, batch_size=64)
+    )
+    det2.fit(rng.rand(160, 2).astype("float32"))
+    return {"dl-a": bankable_models["dl-a"], "dl-f2": det2}
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory, bankable_models):
+    root = tmp_path_factory.mktemp("deadline-collection")
+    for name, det in bankable_models.items():
+        serializer.dump(det, str(root / name), metadata={"name": name})
+    return str(root)
+
+
+def _x_payload(rows=24, cols=3):
+    rng = np.random.RandomState(7)
+    return {"X": rng.rand(rows, cols).tolist()}
+
+
+def _traceparent(tid: str) -> dict:
+    return {"traceparent": f"00-{tid}-{'cd' * 8}-01"}
+
+
+def _flat_names(trace) -> list:
+    return [s.name for s in trace.spans]
+
+
+# ------------------------------------------------------------------ #
+# deadline primitives
+# ------------------------------------------------------------------ #
+
+
+def test_parse_deadline_ms():
+    assert parse_deadline_ms("250") == 250.0
+    assert parse_deadline_ms(" 1500.5 ") == 1500.5
+    # malformed/absent/non-positive/non-finite -> None (server default
+    # applies; the header must never 400 a request)
+    for bad in (None, "", "junk", "-5", "0", "nan", "inf"):
+        assert parse_deadline_ms(bad) is None
+    # hostile huge values clamp instead of minting an immortal deadline
+    from gordo_components_tpu.resilience.deadline import MAX_DEADLINE_MS
+
+    assert parse_deadline_ms("1e300") == MAX_DEADLINE_MS
+
+
+def test_deadline_expiry_and_remaining():
+    d = Deadline(60.0)
+    assert not d.expired()
+    assert 0 < d.remaining_s() <= 60.0
+    assert Deadline(0.0).expired()
+    # remaining clamps at zero: an expired deadline hands no negative
+    # budget downstream
+    assert Deadline(0.0).remaining_s() == 0.0
+    # after_ms round-trips
+    assert 0 < Deadline.after_ms(50).remaining_ms() <= 50
+
+
+def test_default_deadline_env(monkeypatch):
+    monkeypatch.delenv("GORDO_DEFAULT_DEADLINE_MS", raising=False)
+    assert default_deadline_ms() is None
+    monkeypatch.setenv("GORDO_DEFAULT_DEADLINE_MS", "15000")
+    assert default_deadline_ms() == 15000.0
+    # a typo'd fleet-wide knob raises loudly instead of silently
+    # disabling deadline protection
+    monkeypatch.setenv("GORDO_DEFAULT_DEADLINE_MS", "fast")
+    with pytest.raises(ValueError):
+        default_deadline_ms()
+    monkeypatch.setenv("GORDO_DEFAULT_DEADLINE_MS", "-3")
+    with pytest.raises(ValueError):
+        default_deadline_ms()
+
+
+async def test_wait_for_translates_timeout():
+    d = Deadline(0.02)
+    with pytest.raises(DeadlineExceeded):
+        await d.wait_for(asyncio.sleep(5))
+    # DeadlineExceeded IS a timeout: best-effort call sites that already
+    # catch asyncio.TimeoutError degrade identically
+    assert issubclass(DeadlineExceeded, asyncio.TimeoutError)
+
+
+# ------------------------------------------------------------------ #
+# retry budget + decorrelated jitter (client citizenship)
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.chaos
+def test_retry_budget_caps_reoffers_below_1_1x():
+    """The acceptance bound: with ratio=0.1 the total attempts a failing
+    client makes stay under 1.1x its offered load — arithmetic, not
+    configuration discipline."""
+    budget = RetryBudget(ratio=0.1, initial=0.0)
+    offered = 500
+    attempts = 0
+    for _ in range(offered):
+        budget.note_request()
+        attempts += 1  # first offer
+        for _ in range(2):  # client configured with retries=3
+            if not budget.try_spend():
+                break
+            attempts += 1
+    assert attempts <= offered * 1.1
+    assert attempts > offered  # the budget does admit SOME retries
+    snap = budget.snapshot()
+    assert snap["retries_allowed"] == attempts - offered
+    assert snap["retries_denied"] > 0
+
+
+def test_retry_budget_initial_burst_and_cap():
+    budget = RetryBudget(ratio=0.1, initial=2.0, max_tokens=3.0)
+    assert budget.try_spend() and budget.try_spend()  # initial burst
+    assert not budget.try_spend()
+    for _ in range(1000):
+        budget.note_request()
+    # a quiet hour must not bank an unbounded retry storm
+    assert budget.tokens <= 3.0
+
+
+def test_decorrelated_jitter_spreads_and_respects_bounds():
+    rng_a, rng_b = random.Random(1), random.Random(2)
+    prev_a = prev_b = 0.5
+    seq_a, seq_b = [], []
+    for _ in range(8):
+        prev_a = decorrelated_jitter(0.5, prev_a, cap=60.0, rng=rng_a)
+        prev_b = decorrelated_jitter(0.5, prev_b, cap=60.0, rng=rng_b)
+        seq_a.append(prev_a)
+        seq_b.append(prev_b)
+    assert all(0.5 <= d <= 60.0 for d in seq_a + seq_b)
+    # two clients never share a schedule (the whole point: chunks that
+    # failed together must not retry together)
+    assert seq_a != seq_b
+    # deterministic under a pinned rng (replayable tests)
+    rng_c = random.Random(1)
+    assert decorrelated_jitter(0.5, 0.5, cap=60.0, rng=rng_c) == seq_a[0]
+
+
+async def test_fetch_json_uses_jitter_and_honors_retry_after(monkeypatch):
+    from gordo_components_tpu.client import io as io_mod
+
+    calls = {"n": 0}
+
+    async def handler(request):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            hdrs = {"Retry-After": "2"} if calls["n"] == 2 else {}
+            return web.json_response({"err": 1}, status=500, headers=hdrs)
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    sleeps = []
+    real_sleep = asyncio.sleep
+
+    async def fake_sleep(delay, *a, **k):
+        sleeps.append(delay)
+        await real_sleep(0)
+
+    monkeypatch.setattr(io_mod.asyncio, "sleep", fake_sleep)
+    try:
+        body = await io_mod.fetch_json(
+            client.session,
+            f"http://{client.host}:{client.port}/x",
+            backoff=0.05,
+            retries=4,
+            rng=random.Random(3),
+        )
+    finally:
+        await client.close()
+    assert body == {"ok": True}
+    # the global-sleep patch also sees aiohttp's own sleep(0) yields;
+    # the retry sleeps are the nonzero ones
+    retry_sleeps = [d for d in sleeps if d > 0]
+    assert len(retry_sleeps) == 2
+    # first sleep is jittered off the base, NOT the deterministic
+    # backoff*2**attempt ladder; the second obeys the server's
+    # Retry-After drain estimate as a lower bound
+    assert 0.05 <= retry_sleeps[0] <= 60.0
+    assert retry_sleeps[1] >= 2.0
+
+
+async def test_fetch_json_respects_retry_budget():
+    calls = {"n": 0}
+
+    async def handler(request):
+        calls["n"] += 1
+        return web.json_response({"err": 1}, status=500)
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    budget = RetryBudget(ratio=0.0, initial=1.0)
+    url = f"http://{client.host}:{client.port}/x"
+    try:
+        from gordo_components_tpu.client.io import fetch_json
+
+        with pytest.raises(Exception):
+            await fetch_json(
+                client.session, url, backoff=0.001, retries=5,
+                retry_budget=budget,
+            )
+        first = calls["n"]
+        assert first == 2  # 1 offer + the single banked retry token
+        with pytest.raises(Exception):
+            await fetch_json(
+                client.session, url, backoff=0.001, retries=5,
+                retry_budget=budget,
+            )
+        # budget exhausted: the second call fails FAST, no retries
+        assert calls["n"] == first + 1
+        assert budget.snapshot()["retries_denied"] >= 1
+    finally:
+        await client.close()
+
+
+async def test_fetch_json_stamps_remaining_deadline():
+    seen = []
+
+    async def handler(request):
+        seen.append(request.headers.get(DEADLINE_HEADER))
+        if len(seen) == 1:
+            return web.json_response({"err": 1}, status=500)
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        from gordo_components_tpu.client.io import fetch_json
+
+        body = await fetch_json(
+            client.session,
+            f"http://{client.host}:{client.port}/x",
+            backoff=0.02,
+            deadline=Deadline.after_ms(5000),
+            rng=random.Random(0),
+        )
+    finally:
+        await client.close()
+    assert body == {"ok": True}
+    assert len(seen) == 2 and all(seen)
+    # the retry re-stamps the REMAINING budget, not the original
+    assert int(seen[1]) < int(seen[0]) <= 5000
+
+
+async def test_fetch_json_stops_retrying_past_deadline():
+    calls = {"n": 0}
+
+    async def handler(request):
+        calls["n"] += 1
+        return web.json_response({"err": 1}, status=500)
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        from gordo_components_tpu.client.io import fetch_json
+
+        with pytest.raises(Exception):
+            # the 0.2s sleeps blow the 50ms budget after the first retry
+            # window: the loop must stop, not sleep through 5 retries
+            await fetch_json(
+                client.session,
+                f"http://{client.host}:{client.port}/x",
+                backoff=0.2,
+                retries=5,
+                deadline=Deadline.after_ms(50),
+                rng=random.Random(0),
+            )
+    finally:
+        await client.close()
+    assert calls["n"] <= 2
+
+
+# ------------------------------------------------------------------ #
+# hedging
+# ------------------------------------------------------------------ #
+
+
+async def _two_replicas(slow_delay_s: float):
+    async def slow(request):
+        await asyncio.sleep(slow_delay_s)
+        return web.json_response({"replica": "slow"})
+
+    async def fast(request):
+        return web.json_response({"replica": "fast"})
+
+    servers = []
+    for handler in (slow, fast):
+        app = web.Application()
+        app.router.add_post("/score", handler)
+        server = TestServer(app)
+        await server.start_server()
+        servers.append(server)
+    urls = [f"http://{s.host}:{s.port}/score" for s in servers]
+    return servers, urls
+
+
+@pytest.mark.chaos
+async def test_hedged_request_returns_fast_replicas_answer():
+    """The acceptance scenario: a slow primary + fast hedge replica —
+    the caller gets the fast replica's answer, and both hedge counters
+    record it."""
+    import aiohttp
+
+    from gordo_components_tpu.client.io import fetch_json_hedged
+
+    servers, urls = await _two_replicas(slow_delay_s=1.0)
+    stats: dict = {}
+    try:
+        async with aiohttp.ClientSession() as session:
+            t0 = time.monotonic()
+            body = await fetch_json_hedged(
+                session, urls, hedge_delay_s=0.05, hedge_stats=stats,
+                method="POST", json_payload={"X": [[1.0]]},
+            )
+            elapsed = time.monotonic() - t0
+    finally:
+        for s in servers:
+            await s.close()
+    assert body == {"replica": "fast"}
+    assert elapsed < 0.9  # did NOT wait out the slow primary
+    assert stats == {"hedges": 1, "hedge_wins": 1}
+
+
+async def test_fast_primary_never_hedges():
+    import aiohttp
+
+    from gordo_components_tpu.client.io import fetch_json_hedged
+
+    servers, urls = await _two_replicas(slow_delay_s=1.0)
+    stats: dict = {}
+    try:
+        async with aiohttp.ClientSession() as session:
+            body = await fetch_json_hedged(
+                session, list(reversed(urls)),  # fast replica primary
+                hedge_delay_s=0.5, hedge_stats=stats,
+                method="POST", json_payload={"X": [[1.0]]},
+            )
+    finally:
+        for s in servers:
+            await s.close()
+    assert body == {"replica": "fast"}
+    assert stats.get("hedges", 0) == 0  # no duplicate work issued
+
+
+def test_client_hedge_urls_and_watchman_replica_list():
+    from gordo_components_tpu.client.client import Client
+    from gordo_components_tpu.watchman.server import WatchmanState
+
+    state = WatchmanState(
+        "proj", "http://a:1",
+        metrics_urls=[
+            "http://a:1/gordo/v0/proj/metrics",
+            "http://b:2/gordo/v0/proj/metrics/",
+        ],
+    )
+    replicas = state.replica_base_urls()
+    assert replicas == ["http://a:1", "http://b:2"]
+    # the client consumes exactly what watchman serves
+    assert Client.replicas_from_watchman({"replicas": replicas}) == replicas
+    client = Client(
+        "proj", base_url="http://a:1", hedge=True, replica_urls=replicas
+    )
+    urls = client._chunk_urls("m1", "anomaly/prediction")
+    assert urls == [
+        "http://a:1/gordo/v0/proj/m1/anomaly/prediction",
+        "http://b:2/gordo/v0/proj/m1/anomaly/prediction",
+    ]
+    # hedging off (the default): one URL, no duplicate-work surface
+    plain = Client("proj", base_url="http://a:1", replica_urls=replicas)
+    assert len(plain._chunk_urls("m1", "prediction")) == 1
+
+
+async def test_fetch_json_retries_zero_still_sends_one_attempt():
+    calls = {"n": 0}
+
+    async def handler(request):
+        calls["n"] += 1
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        from gordo_components_tpu.client.io import fetch_json
+
+        body = await fetch_json(
+            client.session, f"http://{client.host}:{client.port}/x", retries=0
+        )
+    finally:
+        await client.close()
+    assert body == {"ok": True} and calls["n"] == 1
+
+
+async def test_retry_sleep_never_exceeds_remaining_deadline(monkeypatch):
+    """A Retry-After (or jitter) sleep longer than the chunk's remaining
+    budget is clamped: a dead chunk must not nap through its
+    concurrency slot."""
+    from gordo_components_tpu.client import io as io_mod
+
+    async def handler(request):
+        return web.json_response(
+            {"err": 1}, status=429, headers={"Retry-After": "30"}
+        )
+
+    app = web.Application()
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    sleeps = []
+    real_sleep = asyncio.sleep
+
+    async def fake_sleep(delay, *a, **k):
+        sleeps.append(delay)
+        await real_sleep(0)
+
+    monkeypatch.setattr(io_mod.asyncio, "sleep", fake_sleep)
+    try:
+        with pytest.raises(Exception):
+            await io_mod.fetch_json(
+                client.session,
+                f"http://{client.host}:{client.port}/x",
+                backoff=0.01,
+                retries=3,
+                deadline=Deadline.after_ms(500),
+                rng=random.Random(0),
+            )
+    finally:
+        await client.close()
+    assert all(d <= 0.5 for d in sleeps if d > 0), sleeps
+
+
+def test_client_base_url_trailing_slash_excludes_self_from_hedge():
+    from gordo_components_tpu.client.client import Client
+
+    client = Client(
+        "proj",
+        base_url="http://a:1/",  # trailing slash must still match a:1
+        hedge=True,
+        replica_urls=["http://a:1", "http://b:2"],
+    )
+    for _ in range(16):
+        urls = client._chunk_urls("m1", "prediction")
+        assert len(urls) == 2
+        assert urls[1].startswith("http://b:2/")  # never hedges to itself
+
+
+# ------------------------------------------------------------------ #
+# engine: drop-before-dispatch, score_many group stop, stop() hygiene
+# ------------------------------------------------------------------ #
+
+
+class _SlowProxyBank:
+    """Bank proxy whose batched scoring blocks long enough for queued
+    entries' deadlines to pass; counts device dispatches."""
+
+    def __init__(self, bank: ModelBank, delay_s: float = 0.25):
+        self._bank = bank
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def __contains__(self, name):
+        return name in self._bank
+
+    def score_many(self, requests, traces=None, deadline=None):
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return self._bank.score_many(requests, traces=traces)
+
+    def score(self, name, X, y=None, trace=None):
+        return self.score_many(
+            [(name, X, y)], traces=None if trace is None else [trace]
+        )[0]
+
+
+async def test_engine_drops_expired_entries_before_dispatch(bankable_models):
+    """A queued entry whose deadline passes while an earlier batch
+    executes is resolved with DeadlineExceeded and NEVER dispatched —
+    the device only computes answers someone still wants."""
+    rng = np.random.RandomState(2)
+    X = rng.rand(32, 3).astype("float32")
+    bank = ModelBank.from_models(bankable_models, registry=False)
+    bank.score_many([("dl-a", X, None)])  # pre-compile off the clock
+    proxy = _SlowProxyBank(bank, delay_s=0.3)
+    engine = BatchingEngine(proxy, max_batch=1, flush_ms=1.0)
+    tracer = Tracer(sample=1.0)
+    trace = tracer.start_trace("anomaly")
+    try:
+        t1 = asyncio.ensure_future(engine.score("dl-a", X))
+        await asyncio.sleep(0.1)  # t1 is dispatched, executing its 0.3s
+        t2 = asyncio.ensure_future(
+            engine.score("dl-a", X, deadline=Deadline(0.05), trace=trace,
+                         request_id="rid-expired")
+        )
+        with pytest.raises(DeadlineExceeded) as err:
+            await t2
+        assert "rid-expired" in str(err.value)
+        r1 = await t1  # the live request is untouched
+        assert np.isfinite(r1.total_scaled).all()
+    finally:
+        await engine.stop()
+    assert proxy.calls == 1  # t2 never reached the device
+    assert engine.stats["deadline_expired"] == 1
+    trace.finish(error=True)
+    names = _flat_names(trace)
+    assert "deadline_expired" in names
+    assert "device_execute" not in names
+    assert all(s.end is not None for s in trace.spans)
+
+
+async def test_engine_admission_rejects_already_expired(bankable_models):
+    bank = ModelBank.from_models(bankable_models, registry=False)
+    engine = BatchingEngine(bank, max_batch=4)
+    X = np.random.RandomState(3).rand(16, 3).astype("float32")
+    try:
+        with pytest.raises(DeadlineExceeded):
+            await engine.score("dl-a", X, deadline=Deadline(0.0))
+    finally:
+        await engine.stop()
+    assert engine.stats["deadline_expired"] == 1
+    assert engine.stats["requests"] == 0  # never admitted
+
+
+def test_score_many_stops_between_group_dispatches(two_bucket_models):
+    """A multi-bucket batch whose deadline has run out raises before the
+    next group's XLA dispatch instead of finishing work nobody reads."""
+    rng = np.random.RandomState(4)
+    bank = ModelBank.from_models(two_bucket_models, registry=False)
+    assert bank.n_buckets == 2
+    requests = [
+        ("dl-a", rng.rand(24, 3).astype("float32"), None),
+        ("dl-f2", rng.rand(24, 2).astype("float32"), None),
+    ]
+    # a live deadline scores both groups fine
+    results = bank.score_many(requests, deadline=Deadline(60.0))
+    assert len(results) == 2
+    # an expired one stops before ANY dispatch (monkeypatch-free proof:
+    # score_batch would explode if called)
+    for bucket in bank._buckets.values():
+        bucket.score_batch = None  # dispatching now raises TypeError
+    with pytest.raises(DeadlineExceeded):
+        bank.score_many(requests, deadline=Deadline(0.0))
+
+
+async def test_engine_stop_resolves_expired_and_inflight_pendings(
+    bankable_models,
+):
+    """stop() with a mid-execution batch plus queued entries (expired
+    and live): every future resolves — no caller hangs, nothing leaks."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(24, 3).astype("float32")
+    bank = ModelBank.from_models(bankable_models, registry=False)
+    bank.score_many([("dl-a", X, None)])  # pre-compile
+    proxy = _SlowProxyBank(bank, delay_s=0.4)
+    engine = BatchingEngine(proxy, max_batch=1, flush_ms=1.0)
+    inflight = asyncio.ensure_future(engine.score("dl-a", X))
+    await asyncio.sleep(0.1)  # dispatched into its 0.4s executor sleep
+    queued = [
+        asyncio.ensure_future(
+            engine.score("dl-a", X, deadline=Deadline(0.001))
+        ),
+        asyncio.ensure_future(engine.score("dl-b", X)),
+    ]
+    await asyncio.sleep(0.05)  # both enqueued behind the in-flight batch
+    await engine.stop()
+    results = await asyncio.gather(
+        inflight, *queued, return_exceptions=True
+    )
+    for r in results:
+        # resolved: a real result, a deadline error, or a shutdown
+        # cancellation — never a still-pending future
+        assert not isinstance(r, asyncio.InvalidStateError)
+    assert all(t.done() for t in [inflight, *queued])
+    assert any(
+        isinstance(r, (asyncio.CancelledError, DeadlineExceeded))
+        for r in results
+    )
+
+
+# ------------------------------------------------------------------ #
+# HTTP surface: 504s, traces, metrics (the chaos acceptance scenario)
+# ------------------------------------------------------------------ #
+
+
+async def _serve(artifact_dir, **kwargs):
+    kwargs.setdefault("devices", 1)
+    client = TestClient(TestServer(build_app(artifact_dir, **kwargs)))
+    await client.start_server()
+    return client
+
+
+@pytest.mark.chaos
+async def test_expired_deadline_returns_504_without_device_dispatch(
+    artifact_dir, monkeypatch
+):
+    """ISSUE 4 acceptance: an injected ``engine.queue`` latency fault +
+    a short client deadline -> 504 carrying the request id, the
+    deadline counter rises, and the trace shows NO device_execute span
+    (the device never saw the expired request)."""
+    monkeypatch.setenv("GORDO_TRACE_SAMPLE", "1")
+    resilience.arm("engine.queue", delay_s=0.08, exc=None)
+    client = await _serve(artifact_dir)
+    try:
+        tid = "ab" * 16
+        resp = await client.post(
+            "/gordo/v0/proj/dl-a/prediction",
+            json=_x_payload(),
+            headers={**_traceparent(tid), DEADLINE_HEADER: "20"},
+        )
+        assert resp.status == 504
+        # the 504 names its request, exactly like the 500/410 paths
+        assert resp.headers["X-Request-Id"] == tid
+        body = await resp.json()
+        assert body["request_id"]
+        assert "deadline" in body["error"]
+        tracer = client.app["tracer"]
+        (trace,) = tracer.find(tid)
+        assert trace.finished and trace.error is True
+        assert all(s.end is not None for s in trace.spans)
+        names = _flat_names(trace)
+        assert "deadline_expired" in names
+        assert "device_execute" not in names
+        metrics = await (await client.get("/gordo/v0/proj/metrics")).text()
+        assert "gordo_engine_deadline_expired_total 1" in metrics
+        # the fault passes, the deadline is generous: scoring recovers
+        resilience.reset()
+        resp = await client.post(
+            "/gordo/v0/proj/dl-a/prediction",
+            json=_x_payload(),
+            headers={DEADLINE_HEADER: "60000"},
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+@pytest.mark.chaos
+async def test_server_default_deadline_applies_without_header(
+    artifact_dir, monkeypatch
+):
+    monkeypatch.setenv("GORDO_DEFAULT_DEADLINE_MS", "20")
+    resilience.arm("engine.queue", delay_s=0.08, exc=None)
+    client = await _serve(artifact_dir)
+    try:
+        assert client.app["default_deadline_ms"] == 20.0
+        resp = await client.post(
+            "/gordo/v0/proj/dl-a/prediction", json=_x_payload()
+        )
+        assert resp.status == 504
+        assert resp.headers["X-Request-Id"]  # server-generated, non-empty
+    finally:
+        await client.close()
+
+
+async def test_deadline_504_never_quarantines(artifact_dir, monkeypatch):
+    """Blown deadlines are the clock's fault, not the model's: even past
+    the breaker threshold the model must stay routable."""
+    resilience.arm("engine.queue", delay_s=0.05, exc=None)
+    client = await _serve(artifact_dir, quarantine_threshold=2)
+    try:
+        for _ in range(3):
+            resp = await client.post(
+                "/gordo/v0/proj/dl-a/prediction",
+                json=_x_payload(),
+                headers={DEADLINE_HEADER: "10"},
+            )
+            assert resp.status == 504
+        resilience.reset()
+        resp = await client.post(
+            "/gordo/v0/proj/dl-a/prediction", json=_x_payload()
+        )
+        assert resp.status == 200  # not 410: never quarantined
+    finally:
+        await client.close()
+
+
+async def test_per_model_path_504_records_span(artifact_dir, monkeypatch):
+    """With the bank disabled the per-model path still 504s on an
+    expired budget AND records the deadline_expired span (the engine
+    counter series doesn't exist without an engine)."""
+    monkeypatch.setenv("GORDO_TRACE_SAMPLE", "1")
+    client = await _serve(artifact_dir, use_bank=False)
+    try:
+        tid = "cd" * 16
+        # a 1ms budget the (deliberately large) JSON parse outspends
+        resp = await client.post(
+            "/gordo/v0/proj/dl-a/prediction",
+            json=_x_payload(rows=4000),
+            headers={**_traceparent(tid), DEADLINE_HEADER: "1"},
+        )
+        assert resp.status == 504
+        assert resp.headers["X-Request-Id"] == tid
+        (trace,) = client.app["tracer"].find(tid)
+        spans = {s.name: s for s in trace.spans}
+        assert "deadline_expired" in spans
+        assert spans["deadline_expired"].attributes.get("where") == "per-model"
+        assert "device_execute" not in spans
+    finally:
+        await client.close()
+
+
+async def test_malformed_deadline_header_is_ignored(artifact_dir):
+    client = await _serve(artifact_dir)
+    try:
+        resp = await client.post(
+            "/gordo/v0/proj/dl-a/prediction",
+            json=_x_payload(),
+            headers={DEADLINE_HEADER: "soon-ish"},
+        )
+        assert resp.status == 200  # telemetry hint, never an outage
+    finally:
+        await client.close()
+
+
+# ------------------------------------------------------------------ #
+# hot-loop overhead guard (CI lane: make hotloop)
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.hotloop
+def test_deadline_check_overhead_within_5pct(bankable_models):
+    """The deadline bookkeeping on the scoring path must stay within 5%
+    — measured in its WORST case (a live deadline checked per bucket
+    group) against the no-header configuration (deadline=None), which
+    is itself strictly cheaper. Interleaved best-of-N so machine drift
+    hits both sides."""
+    rng = np.random.RandomState(6)
+    bank = ModelBank.from_models(bankable_models, registry=False)
+    requests = [
+        (name, rng.rand(64, 3).astype("float32"), None)
+        for name in bankable_models
+    ]
+    bank.score_many(requests)  # warm/compile
+
+    def timed(deadline, iters=40):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bank.score_many(requests, deadline=deadline)
+        return time.perf_counter() - t0
+
+    rounds, ratios = 7, []
+    for _ in range(rounds):
+        control = timed(None)
+        instrumented = timed(Deadline(3600.0))
+        ratios.append(instrumented / control)
+    assert min(ratios) <= 1.05, ratios
