@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline hotloop trace-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline hotloop perf-guard trace-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -35,7 +35,13 @@ chaos-deadline:
 # regression that makes "off" cost >5% on the serving loop fails HERE,
 # not buried in the full run
 hotloop:
-	$(PYTHON) -m pytest tests/ -q -m hotloop
+	$(PYTHON) -m pytest tests/ -q -m hotloop --continue-on-collection-errors
+
+# perf-guard lane: every hot-loop overhead guard PLUS the pipelined-vs-
+# serial parity+no-slower check (tests/test_bank_pipeline.py) — the
+# scoring pipeline must never regress below the serial path it replaced
+perf-guard:
+	$(PYTHON) -m pytest tests/ -q -m "hotloop or perfguard" --continue-on-collection-errors
 
 # short serve loop with tracing at sample=1.0; prints the top-3 slow
 # traces with their per-stage breakdown (tools/trace_demo.py)

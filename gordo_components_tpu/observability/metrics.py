@@ -335,6 +335,13 @@ class MetricsRegistry:
         with self._lock:
             self._collectors[key] = fn
 
+    def get_collector(self, key: str) -> Optional[Callable[[], Iterable[tuple]]]:
+        """The collector currently registered under ``key`` (None if
+        absent) — lets a replacement collector read its predecessor's
+        final values so counter series stay monotonic across swaps."""
+        with self._lock:
+            return self._collectors.get(key)
+
     # ----------------------------- reads ------------------------------ #
 
     def _all_samples(self):

@@ -258,6 +258,8 @@ def build_app(
     bank_max_queue: Optional[int] = None,
     devices: Optional[int] = None,
     quarantine_threshold: Optional[int] = None,
+    bank_inflight: Optional[int] = None,
+    arena_max_mb: Optional[float] = None,
 ) -> web.Application:
     """App factory: loads the artifact(s) under ``model_dir`` once.
 
@@ -271,6 +273,14 @@ def build_app(
     ``models``-axis mesh so a multi-chip server slice holds each model
     once and routes requests to the owning chip — the layout the
     generated manifests' ``server_devices`` request assumes.
+
+    Hot-path pipeline knobs (docs/operations.md "Hot-path pipeline &
+    tuning"): ``bank_inflight`` (env ``GORDO_BANK_INFLIGHT``) bounds how
+    many bucket groups ``score_many`` keeps in flight on the device;
+    ``arena_max_mb`` (env ``GORDO_ARENA_MAX_MB``) bounds the
+    padded-buffer arena. ``GORDO_COMPILE_CACHE_DIR`` arms the persistent
+    XLA compilation cache before the bank's bucket programs build, so a
+    restarted replica re-warms from disk instead of recompiling.
     """
     def env_int(
         name: str, default: Optional[str] = None, hint: str = ""
@@ -292,6 +302,22 @@ def build_app(
     # chaos/fault config: arms any GORDO_FAULTS sites before the first
     # artifact load / bucket compile can hit them; no-op when unset
     configure_from_env()
+    # persistent XLA compilation cache (same knob the builder CLI wires):
+    # armed BEFORE the bank compiles its bucket programs, so a restarted
+    # or rolling-deployed replica loads them from the shared volume
+    # instead of stalling its first requests on recompiles
+    cache_dir = os.environ.get("GORDO_COMPILE_CACHE_DIR")
+    if cache_dir:
+        from gordo_components_tpu.utils.profiling import enable_compile_cache
+
+        try:
+            enable_compile_cache(cache_dir)
+        except Exception:
+            logger.warning(
+                "GORDO_COMPILE_CACHE_DIR=%s: could not enable the "
+                "persistent compilation cache; serving continues without it",
+                cache_dir, exc_info=True,
+            )
     if use_bank is None:
         use_bank = os.environ.get("GORDO_SERVER_BANK", "1") != "0"
     if devices is None:
@@ -368,10 +394,21 @@ def build_app(
         "max_batch": bank_max_batch,
         "flush_ms": bank_flush_ms,
         "max_queue": bank_max_queue,
+        # pipeline knobs, remembered so /reload rebuilds the bank with
+        # the same window/arena budget the app booted with (None = the
+        # env/default resolution inside ModelBank)
+        "inflight": bank_inflight,
+        "arena_max_mb": arena_max_mb,
     }
     app["bank_mesh"] = mesh  # reload (views.py) rebuilds under the same mesh
     if use_bank:
-        bank = ModelBank.from_models(collection.models, mesh=mesh, registry=registry)
+        bank = ModelBank.from_models(
+            collection.models,
+            mesh=mesh,
+            registry=registry,
+            inflight=bank_inflight,
+            arena_max_mb=arena_max_mb,
+        )
         # expose the bank even when nothing banked: /models reports the
         # coverage (banked vs per-model fallback, with reasons)
         app["bank"] = bank

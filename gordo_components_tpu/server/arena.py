@@ -1,0 +1,142 @@
+"""Shape-keyed padded-buffer arena for the bank's coalesced hot loop.
+
+``ModelBank.score_many`` used to allocate (and zero) a fresh
+``np.zeros((B, T, F))`` pair for every bucket-group dispatch — at the
+north-star request mix that is megabytes of allocator churn per call,
+and round-5 profiling flagged it as the top host cost in the coalesced
+loop. The arena keeps a bounded LRU pool of scratch buffers keyed by
+exact shape+dtype: a hit returns a *dirty* buffer (the caller overwrites
+the data region with real rows and zeroes only the pad tail), a miss
+allocates a fresh zeroed one. Pool size is bounded by
+``GORDO_ARENA_MAX_MB`` (default 256; ``0`` disables pooling entirely —
+every acquire is a plain ``np.zeros`` and the arena keeps no state,
+which is also the serial-parity baseline the pipeline tests compare
+against).
+
+Thread-safety: acquire/release take one lock around dict ops only — the
+fill loop (the actual hot part) runs lock-free on the caller's buffer.
+Buffers are returned by the pipeline only after the group's outputs are
+fetched, so a pooled buffer is never handed to a new request while a
+device computation could still read it.
+"""
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["PaddedArena", "DEFAULT_MAX_MB"]
+
+DEFAULT_MAX_MB = 256.0
+
+
+def _env_max_bytes() -> int:
+    raw = os.environ.get("GORDO_ARENA_MAX_MB")
+    if raw is None:
+        return int(DEFAULT_MAX_MB * 1024 * 1024)
+    try:
+        return int(float(raw) * 1024 * 1024)
+    except ValueError:
+        raise ValueError(
+            f"GORDO_ARENA_MAX_MB must be a number of megabytes, got {raw!r}"
+        ) from None
+
+
+class PaddedArena:
+    """Bounded LRU pool of reusable padded scratch buffers.
+
+    ``acquire(shape)`` returns ``(buffer, clean)``: ``clean`` is True for
+    a freshly zeroed allocation (pool miss, or pooling disabled) and
+    False for a reused buffer whose pad regions the caller must zero.
+    ``release(buffer)`` returns it to the pool, evicting
+    least-recently-used *shapes* while the pooled bytes exceed the
+    budget. ``outstanding`` counts acquired-but-unreleased buffers — the
+    leak detector the chaos tests assert back to zero.
+    """
+
+    def __init__(self, max_bytes: int = None):
+        self.max_bytes = _env_max_bytes() if max_bytes is None else int(max_bytes)
+        # shape/dtype key -> stack of free buffers; OrderedDict order is
+        # recency (most recently used at the end)
+        self._pool: "OrderedDict[Tuple[tuple, str], List[np.ndarray]]" = (
+            OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.pooled_bytes = 0
+        self.outstanding = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    def acquire(self, shape, dtype=np.float32):
+        if self.max_bytes <= 0:
+            return np.zeros(shape, dtype), True
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            stack = self._pool.get(key)
+            if stack:
+                buf = stack.pop()
+                if not stack:
+                    del self._pool[key]
+                else:
+                    self._pool.move_to_end(key)
+                self.pooled_bytes -= buf.nbytes
+                self.hits += 1
+                self.outstanding += 1
+                return buf, False
+        # allocate outside the lock (np.zeros is the expensive part) and
+        # count only a SUCCESSFUL allocation: a MemoryError here must not
+        # strand the outstanding counter the leak detectors assert on
+        buf = np.zeros(shape, dtype)
+        with self._lock:
+            self.misses += 1
+            self.outstanding += 1
+        return buf, True
+
+    def release(self, buf: np.ndarray) -> None:
+        if self.max_bytes <= 0:
+            return
+        key = (buf.shape, buf.dtype.str)
+        with self._lock:
+            self.outstanding -= 1
+            if buf.nbytes > self.max_bytes:
+                # a single buffer larger than the whole budget is simply
+                # not pooled: admitting it would evict every OTHER shape
+                # from the pool before the budget check reached it
+                self.evictions += 1
+                return
+            self._pool.setdefault(key, []).append(buf)
+            self._pool.move_to_end(key)
+            self.pooled_bytes += buf.nbytes
+            # evict least-recently-used shapes until back under budget
+            while self.pooled_bytes > self.max_bytes and self._pool:
+                k, stack = next(iter(self._pool.items()))
+                victim = stack.pop()
+                if not stack:
+                    del self._pool[k]
+                self.pooled_bytes -= victim.nbytes
+                self.evictions += 1
+
+    def stats(self) -> Dict[str, object]:
+        # under the lock: /stats scrapes read this from the event-loop
+        # thread while the scoring executor mutates the pool, and an
+        # unlocked dict iteration can raise mid-resize
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "enabled": self.enabled,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "evictions": self.evictions,
+                "pooled_bytes": self.pooled_bytes,
+                "pooled_buffers": sum(len(s) for s in self._pool.values()),
+                "outstanding": self.outstanding,
+            }

@@ -28,10 +28,13 @@ surfaced per model through ``ModelBank.coverage`` and ``GET /models``.
 import asyncio
 import contextlib
 import functools
+import inspect
 import json
 import logging
 import os
 import time
+import weakref
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -49,6 +52,7 @@ from gordo_components_tpu.observability import get_registry
 from gordo_components_tpu.ops.scaler import ScalerParams
 from gordo_components_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from gordo_components_tpu.resilience.faults import faultpoint
+from gordo_components_tpu.server.arena import PaddedArena
 
 logger = logging.getLogger(__name__)
 
@@ -430,6 +434,78 @@ class ScoreResult:
         )
 
 
+def _slice_single(outs, slot, n_out: int):
+    """Single-chunk reassembly (the serving-path norm): one sliced copy
+    per output array instead of concatenate machinery. The copy is
+    deliberate: a view would pin the whole (B, T, ...) batch output alive
+    as long as any one result is held, and would be read-only where the
+    multi-chunk path returns writable arrays."""
+    return tuple(a[slot][:n_out].copy() for a in outs)
+
+
+def _concat_chunks(outs, slots, cis, valids, n_out: int):
+    """Multi-chunk reassembly: each chunk contributes its VALID output
+    rows (rows computed from real, unpadded input)."""
+    return tuple(
+        np.concatenate(
+            [a[slots[ci]][:v] for ci, v in zip(cis, valids)], axis=0
+        )[:n_out]
+        for a in outs
+    )
+
+
+class _GroupRun:
+    """One bucket group's trip through the scoring pipeline.
+
+    Built by ``_host_prep`` (coalesce + pad into arena buffers), handed
+    to ``_dispatch`` (async XLA call — ``out`` holds device arrays whose
+    computation may still be in flight), finished by ``_postprocess``
+    (fence, fetch, reassemble, release buffers). Keeping the whole group
+    state in one object is what lets ``score_many`` hold several groups
+    in flight at once."""
+
+    __slots__ = (
+        "bucket", "req_ids", "req_plans", "slots", "n_chunks",
+        "Xb", "Yb", "idx", "score_fn", "out", "off", "group_traces",
+        "t_group", "t_chunks", "t_pad", "t_dispatch", "t_ready",
+        "t_device_done", "profile_dir", "_bufs",
+    )
+
+    def __init__(self):
+        self.out = None
+        self.t_dispatch = 0.0
+        self.t_ready = 0.0
+        # earliest time the outputs were OBSERVED ready (polled at host
+        # stage boundaries); 0.0 until then — the fence time is only an
+        # upper bound that absorbs whatever host work ran in between
+        self.t_device_done = 0.0
+        self.profile_dir = None
+        self._bufs = ()
+
+    def poll_ready(self, now: float) -> None:
+        """Stamp ``t_device_done`` if the device outputs have become
+        ready — called at host stage boundaries so the overlap
+        accounting sees device completion near when it happened instead
+        of at the (possibly much later) fence."""
+        if self.t_device_done or self.out is None:
+            return
+        try:
+            if all(a.is_ready() for a in self.out):
+                self.t_device_done = now
+        except Exception:
+            # no is_ready on this array type, or the async computation
+            # already failed device-side: a poll must never raise — the
+            # fence in _postprocess surfaces device errors inside the
+            # owning group's handler, keeping per-group isolation intact
+            pass
+
+    def release(self, arena: PaddedArena) -> None:
+        """Return the padded input buffers to the arena (idempotent)."""
+        bufs, self._bufs = self._bufs, ()
+        for buf in bufs:
+            arena.release(buf)
+
+
 class ModelBank:
     """Stacked scoring bank over a model collection (HBM-resident).
 
@@ -439,9 +515,44 @@ class ModelBank:
     :class:`_Bucket`. Without it the bank is single-device, exactly as
     before."""
 
-    def __init__(self, max_rows_per_call: int = 8192, mesh=None, registry=None):
+    def __init__(
+        self,
+        max_rows_per_call: int = 8192,
+        mesh=None,
+        registry=None,
+        inflight: Optional[int] = None,
+        arena_max_mb: Optional[float] = None,
+    ):
         self.max_rows = int(max_rows_per_call)
         self.mesh = mesh
+        # pipeline depth: how many bucket groups may be in flight on the
+        # device at once (env GORDO_BANK_INFLIGHT, default 2). While
+        # group k executes, group k+1 is padded on the host and group
+        # k-1's outputs are fetched — 1 disables the overlap (serial
+        # prep->dispatch->fetch per group, the parity baseline).
+        if inflight is None:
+            raw = os.environ.get("GORDO_BANK_INFLIGHT", "2")
+            try:
+                inflight = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"GORDO_BANK_INFLIGHT must be an integer, got {raw!r}"
+                ) from None
+        self._inflight_window = max(1, int(inflight))
+        self._inflight_now = 0
+        self.arena = PaddedArena(
+            None if arena_max_mb is None else int(arena_max_mb * 1024 * 1024)
+        )
+        # host/device overlap accounting, aggregated across multi-group
+        # calls: device_busy sums the non-overlapping per-group device
+        # windows, wall the whole call — their ratio is the overlap the
+        # pipeline buys (serial padding+fetching shows up as ratio << 1)
+        self._pipe = {
+            "calls": 0,
+            "multi_group_calls": 0,
+            "wall_s": 0.0,
+            "device_busy_s": 0.0,
+        }
         self._buckets: Dict[str, _Bucket] = {}
         self._index: Dict[str, Tuple[str, int]] = {}  # name -> (bucket_key, i)
         self._tags: Dict[str, List[str]] = {}
@@ -500,8 +611,6 @@ class ModelBank:
             # weakref: these read-through closures live in a potentially
             # process-global registry; a strong self capture would pin a
             # discarded bank's stacked params (GBs at fleet scale) forever
-            import weakref
-
             ref = weakref.ref(self)
             registry.gauge(
                 "gordo_bank_models", "Models resident in the HBM bank"
@@ -513,6 +622,85 @@ class ModelBank:
             ).labels().set_function(
                 lambda: len(b._buckets) if (b := ref()) is not None else 0
             )
+
+            # pipeline/arena series, read-through from the live counters
+            # (stability contract, docs/observability.md). A collector —
+            # not mirrored cells — so the hot loop pays nothing beyond
+            # the plain-int increments it already makes; keyed so a
+            # /reload's rebuilt bank replaces the old bank's emission.
+            # The series carry the replaced bank's values: a /reload
+            # passes the same registry exactly so counters stay
+            # monotonic, and a scrape must never see hits/misses drop
+            # back to zero. The predecessor's values stay LIVE (re-read
+            # from its collector at render time) while the old bank is
+            # still serving during the reload's construct+warmup window
+            # — its gauges (pooled bytes, in-flight groups) are summed
+            # in so that window doesn't mask a working pipeline — and
+            # once the old bank is collected the counter baseline
+            # freezes at its last observed values while the gauge
+            # contribution drops to zero (gauges are point-in-time).
+            base = {
+                "hits": 0, "misses": 0, "bytes": 0, "inflight": 0,
+                "prev": registry.get_collector("bank_pipeline"),
+            }
+
+            def _refresh_base():
+                prev = base["prev"]
+                if prev is None:
+                    return
+                rows = ()
+                with contextlib.suppress(Exception):
+                    rows = tuple(prev())
+                if not rows:
+                    # predecessor bank was GC'd (its collector yields
+                    # nothing): freeze the counter baseline, zero the
+                    # gauge carry, and drop the chain link so renders
+                    # stop walking dead closures
+                    base["prev"] = None
+                    base["bytes"] = base["inflight"] = 0
+                    return
+                for pname, _t, _h, _l, pval in rows:
+                    if pname == "gordo_bank_arena_hits_total":
+                        base["hits"] = int(pval)
+                    elif pname == "gordo_bank_arena_misses_total":
+                        base["misses"] = int(pval)
+                    elif pname == "gordo_bank_arena_bytes":
+                        base["bytes"] = int(pval)
+                    elif pname == "gordo_bank_inflight_groups":
+                        base["inflight"] = int(pval)
+
+            _refresh_base()
+
+            def _pipeline_collect():
+                bank = ref()
+                if bank is None:
+                    return ()
+                _refresh_base()
+                arena = bank.arena
+                return (
+                    (
+                        "gordo_bank_arena_hits_total", "counter",
+                        "Padded-buffer arena reuses on the coalesced loop",
+                        {}, base["hits"] + arena.hits,
+                    ),
+                    (
+                        "gordo_bank_arena_misses_total", "counter",
+                        "Padded-buffer arena allocations (pool miss)",
+                        {}, base["misses"] + arena.misses,
+                    ),
+                    (
+                        "gordo_bank_arena_bytes", "gauge",
+                        "Bytes held in the padded-buffer arena pool",
+                        {}, base["bytes"] + arena.pooled_bytes,
+                    ),
+                    (
+                        "gordo_bank_inflight_groups", "gauge",
+                        "Bucket groups currently in flight in the scoring "
+                        "pipeline", {}, base["inflight"] + bank._inflight_now,
+                    ),
+                )
+
+            registry.collector(_pipeline_collect, key="bank_pipeline")
         else:
             # all six, not just the one score_many guards on: a future
             # call site guarding on its own attribute must get None, not
@@ -634,23 +822,99 @@ class ModelBank:
             "devices": int(self.mesh.devices.size) if self.mesh is not None else 1,
         }
 
-    def warmup(self, rows: int = 256) -> int:
-        """Pre-compile each bucket's scoring program for the common
-        (batch=1, rows) shape so the FIRST real request doesn't pay the
-        XLA compile (seconds) — run at server startup, off the request
-        path. Returns the number of buckets warmed."""
+    def pipeline_stats(self) -> Dict[str, Any]:
+        """Operator-facing pipeline/arena summary (served in ``/stats``
+        as ``bank_pipeline``; bench and the north-star check snapshot it
+        so the overlap trajectory is auditable)."""
+        pipe = self._pipe
+        wall = pipe["wall_s"]
+        return {
+            "inflight_window": self._inflight_window,
+            "arena": self.arena.stats(),
+            "overlap": {
+                "calls": pipe["calls"],
+                "multi_group_calls": pipe["multi_group_calls"],
+                "device_busy_s": round(pipe["device_busy_s"], 6),
+                "wall_s": round(wall, 6),
+                "overlap_ratio": (
+                    round(pipe["device_busy_s"] / wall, 4) if wall > 0 else None
+                ),
+            },
+        }
+
+    @staticmethod
+    def _warmup_grid_env(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
+        raw = os.environ.get(name)
+        if not raw:
+            return default
+        try:
+            vals = tuple(int(v) for v in raw.split(",") if v.strip())
+        except ValueError:
+            logger.warning(
+                "%s must be comma-separated integers, got %r; using %s",
+                name, raw, default,
+            )
+            return default
+        return vals or default
+
+    def warmup(self, rows=None, batch_sizes=None) -> int:
+        """Pre-compile each bucket's scoring program over a (B, T) shape
+        grid so neither the first request NOR the first coalesced burst
+        pays an XLA compile (seconds) — run at server startup, off the
+        request path. Returns the number of buckets warmed.
+
+        ``rows`` is an int or sequence of row counts (default env
+        ``GORDO_WARMUP_ROWS``, else 256); ``batch_sizes`` a sequence of
+        batch widths (default env ``GORDO_WARMUP_BATCHES``, else ``1``).
+        Both are rounded up to the pow2 ladder score_many actually
+        dispatches, and the grid is their cross product — with the
+        persistent compilation cache (``GORDO_COMPILE_CACHE_DIR``) the
+        grid compiles once per fleet, not once per restart."""
+        if rows is None:
+            row_list = self._warmup_grid_env("GORDO_WARMUP_ROWS", (256,))
+        elif isinstance(rows, int):
+            row_list = (rows,)
+        else:
+            row_list = tuple(rows)
+        if batch_sizes is None:
+            batch_sizes = self._warmup_grid_env("GORDO_WARMUP_BATCHES", (1,))
+        batches = sorted({_next_pow2(max(1, int(b))) for b in batch_sizes})
         warmed = 0
+        total_shapes = 0
         for bucket in self._buckets.values():
-            T = max(_next_pow2(rows), _next_pow2(bucket.offset + 1))
+            shapes = sorted(
+                {
+                    (
+                        # EXACTLY score_many's T computation (clamp to
+                        # max_rows, then floor at the warm-up window) —
+                        # warming any other shape leaves the dispatched
+                        # one cold and compiles a dead program
+                        max(
+                            min(
+                                _next_pow2(max(1, int(r))),
+                                _prev_pow2(self.max_rows),
+                            ),
+                            _next_pow2(bucket.offset + 1),
+                        ),
+                        B,
+                    )
+                    for r in row_list
+                    for B in batches
+                }
+            )
             try:
-                if self.mesh is None:
-                    X = np.zeros((1, T, bucket.n_features), np.float32)
-                    bucket.score_batch(np.zeros((1,), np.int32), X, X)
-                else:
-                    D = bucket.n_shards
-                    X = np.zeros((D, 1, T, bucket.n_features), np.float32)
-                    bucket.score_batch_sharded(np.zeros((D, 1), np.int32), X, X)
+                for T, B in shapes:
+                    if self.mesh is None:
+                        X = np.zeros((B, T, bucket.n_features), np.float32)
+                        bucket.score_batch(np.zeros((B,), np.int32), X, X)
+                    else:
+                        D = bucket.n_shards
+                        X = np.zeros((D, B, T, bucket.n_features), np.float32)
+                        bucket.score_batch_sharded(
+                            np.zeros((D, B), np.int32), X, X
+                        )
                 warmed += 1
+                total_shapes += len(shapes)
             except Exception:
                 logger.warning(
                     "bank warmup failed for bucket %s/%s",
@@ -658,8 +922,9 @@ class ModelBank:
                 )
         if warmed:
             logger.info(
-                "Model bank warmed: %d bucket(s) pre-compiled at %d rows",
-                warmed, rows,
+                "Model bank warmed: %d bucket(s) pre-compiled over %d "
+                "(rows, batch) shape(s)",
+                warmed, total_shapes,
             )
         return warmed
 
@@ -692,11 +957,20 @@ class ModelBank:
         requests: Sequence[Tuple[str, np.ndarray, Optional[np.ndarray]]],
         traces: Optional[Sequence[Any]] = None,
         deadline: Optional[Deadline] = None,
-    ) -> List[ScoreResult]:
+        return_exceptions: bool = False,
+    ) -> List[Any]:
         """Score a heterogeneous batch of (name, X, y) requests.
 
-        Requests are grouped by bucket, padded to pow2 (batch, rows) and
-        scored in one XLA call per group.
+        Requests are grouped by bucket and each group runs through a
+        three-stage software pipeline — :meth:`_host_prep` (coalesce +
+        pad into arena scratch buffers), :meth:`_dispatch` (the XLA call,
+        returned WITHOUT fetching so JAX async dispatch keeps the device
+        queue full), :meth:`_postprocess` (fence + fetch + reassemble) —
+        with up to ``GORDO_BANK_INFLIGHT`` (default 2) groups in flight:
+        while group k executes on the device, group k+1 is padded on the
+        host and group k-1's outputs are fetched. Heterogeneous
+        multi-bucket batches no longer serialize host and device work;
+        outputs are bitwise identical to the serial (window=1) order.
 
         ``deadline`` (optional, the batch's earliest
         :class:`~gordo_components_tpu.resilience.deadline.Deadline`) is
@@ -709,131 +983,303 @@ class ModelBank:
 
         ``traces`` (optional, request-aligned; entries may be None) are
         :class:`~gordo_components_tpu.observability.tracing.Trace`
-        objects to record the hot-path stage spans into — ``coalesce``
-        (group/validate/chunk), ``pad`` (batch assembly), and
-        ``device_execute``/``postprocess`` with the device work fenced by
-        ``jax.block_until_ready`` so execution and host transfer stop
-        blurring together. The whole stage-timing path is skipped when no
+        objects to record the hot-path stage spans into — ``coalesce``,
+        ``pad``, ``device_execute`` (dispatch -> fenced-ready, the
+        group's device window), ``postprocess``, plus one
+        ``pipeline_overlap`` span per multi-group call carrying the
+        measured overlap ratio. The stage-timing path is skipped when no
         request in a group is traced (the near-free-when-disabled
         contract; see the tracing hot-loop overhead guard).
+
+        ``return_exceptions`` (the batching engine's mode): instead of
+        raising on the first failure, a failed bucket group's requests
+        get their exception as their result-list entry while every other
+        group still returns real :class:`ScoreResult` objects — one
+        poisoned group no longer discards a whole coalesced batch.
         """
-        _FP_SCORE.fire()
-        results: List[Optional[ScoreResult]] = [None] * len(requests)
+        results: List[Any] = [None] * len(requests)
+        errors: Dict[int, Exception] = {}
         by_bucket: Dict[str, List[int]] = {}
         for ri, (name, X, _y) in enumerate(requests):
-            if name not in self._index:
-                raise KeyError(f"Model {name!r} not in bank")
-            by_bucket.setdefault(self._index[name][0], []).append(ri)
+            entry = self._index.get(name)
+            if entry is None:
+                exc = KeyError(f"Model {name!r} not in bank")
+                if not return_exceptions:
+                    raise exc
+                errors[ri] = exc
+                continue
+            by_bucket.setdefault(entry[0], []).append(ri)
 
-        for key, req_ids in by_bucket.items():
-            if deadline is not None and deadline.expired():
-                # stop between group dispatches: the budget the engine
-                # admitted this batch under has run out, and the next
-                # XLA call would compute answers nobody reads
-                raise DeadlineExceeded(
-                    f"batch deadline expired before all {len(by_bucket)} "
-                    f"bucket group(s) dispatched "
-                    f"(budget {deadline.budget_s * 1e3:.0f}ms)"
-                )
-            bucket = self._buckets[key]
-            group_traces = None
+        groups = list(by_bucket.items())
+        n_groups = len(groups)
+        window = self._inflight_window
+        inflight: "deque[_GroupRun]" = deque()
+        t_call = time.monotonic()
+        device_busy = 0.0
+        last_ready = t_call
+
+        def poll_inflight() -> None:
+            # stamp device completions at host stage boundaries: without
+            # this, a group's device window would only close at its
+            # fence — absorbing any host work run in between and pinning
+            # the measured overlap ratio near 1.0 no matter how long the
+            # device actually idled
+            if inflight:
+                now = time.monotonic()
+                for r in inflight:
+                    r.poll_ready(now)
+
+        def finish(run: _GroupRun) -> None:
+            nonlocal device_busy, last_ready
+            poll_inflight()
+            try:
+                self._postprocess(run, requests, results, traces)
+            except Exception as exc:
+                if not return_exceptions:
+                    raise
+                for ri in run.req_ids:
+                    errors[ri] = exc
+            # window end: the earliest OBSERVED completion — the polled
+            # stamp when the device finished during host work, the fence
+            # time when the host genuinely waited (then the fence end IS
+            # the completion). Windows never overlap: queue wait behind
+            # the previous group's execution must not be counted twice.
+            t_done = run.t_device_done or run.t_ready
+            device_busy += max(0.0, t_done - max(run.t_dispatch, last_ready))
+            last_ready = max(last_ready, t_done)
+
+        try:
+            for gi, (key, req_ids) in enumerate(groups):
+                if deadline is not None and deadline.expired():
+                    # stop between group dispatches: the budget the engine
+                    # admitted this batch under has run out, and the next
+                    # XLA call would compute answers nobody reads
+                    exc = DeadlineExceeded(
+                        f"batch deadline expired before all {n_groups} "
+                        f"bucket group(s) dispatched "
+                        f"(budget {deadline.budget_s * 1e3:.0f}ms)"
+                    )
+                    if not return_exceptions:
+                        raise exc
+                    for _key, rids in groups[gi:]:
+                        for ri in rids:
+                            errors[ri] = exc
+                    break
+                run = None
+                try:
+                    run = self._host_prep(key, req_ids, requests, traces)
+                    self._dispatch(run)
+                except Exception as exc:
+                    # the failed group's own buffers (host_prep cleans up
+                    # after itself, but a dispatch failure leaves them on
+                    # the run) go back to the arena either way
+                    if run is not None:
+                        run.release(self.arena)
+                    if not return_exceptions:
+                        raise
+                    for ri in req_ids:
+                        errors[ri] = exc
+                    continue
+                inflight.append(run)
+                self._inflight_now = len(inflight)
+                poll_inflight()  # completions during this group's prep
+                if len(inflight) >= window:
+                    finish(inflight.popleft())
+                    self._inflight_now = len(inflight)
+            while inflight:
+                finish(inflight.popleft())
+                self._inflight_now = len(inflight)
+        except BaseException:
+            # an aborted call must not leak arena buffers or abandon
+            # device work mid-flight: fence and release every in-flight
+            # group before the exception propagates, so no buffer is
+            # ever handed to a later request while still referenced
+            for run in inflight:
+                with contextlib.suppress(Exception):
+                    jax.block_until_ready(run.out)
+                run.release(self.arena)
+            self._inflight_now = 0
+            raise
+        self._inflight_now = 0
+
+        self._pipe["calls"] += 1
+        if n_groups > 1:
+            t_end = time.monotonic()
+            wall = t_end - t_call
+            self._pipe["multi_group_calls"] += 1
+            self._pipe["wall_s"] += wall
+            self._pipe["device_busy_s"] += device_busy
             if traces is not None:
-                group_traces = [
-                    t for t in (traces[ri] for ri in req_ids) if t is not None
-                ] or None
-            t_group = time.monotonic() if group_traces else 0.0
-            F = bucket.n_features
-            off = bucket.offset
-            rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
-            for ri, X in zip(req_ids, rows):
-                if X.ndim != 2 or X.shape[1] != F:
+                ratio = device_busy / wall if wall > 0 else 0.0
+                for ri, tr in enumerate(traces):
+                    # only requests that actually rode the pipeline: a
+                    # never-grouped (unknown-model) or deadline-dropped
+                    # request must not show device work in its trace
+                    if tr is None or ri in errors:
+                        continue
+                    tr.add_span(
+                        "pipeline_overlap", t_call, t_end,
+                        groups=n_groups, window=window,
+                        device_busy_ms=round(device_busy * 1e3, 3),
+                        overlap_ratio=round(ratio, 4),
+                    )
+        for ri, exc in errors.items():
+            results[ri] = exc
+        return results
+
+    def _host_prep(
+        self,
+        key: str,
+        req_ids: List[int],
+        requests: Sequence[Tuple[str, np.ndarray, Optional[np.ndarray]]],
+        traces: Optional[Sequence[Any]],
+    ) -> _GroupRun:
+        """Pipeline stage 1 — coalesce + pad (pure host work).
+
+        Validates the group's requests, chunks long ones (sequence chunks
+        OVERLAP by the warm-up so no output rows are lost at chunk
+        boundaries), and assembles the pow2-padded batch arrays in arena
+        scratch buffers, zeroing only the pad tail of reused buffers."""
+        bucket = self._buckets[key]
+        run = _GroupRun()
+        run.bucket = bucket
+        run.req_ids = req_ids
+        group_traces = None
+        if traces is not None:
+            group_traces = [
+                t for t in (traces[ri] for ri in req_ids) if t is not None
+            ] or None
+        run.group_traces = group_traces
+        run.t_group = time.monotonic() if group_traces else 0.0
+        F = bucket.n_features
+        off = bucket.offset
+        run.off = off
+        rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
+        for ri, X in zip(req_ids, rows):
+            if X.ndim != 2 or X.shape[1] != F:
+                raise ValueError(
+                    f"Request for {requests[ri][0]!r}: expected (rows, {F}), "
+                    f"got {X.shape}"
+                )
+            if X.shape[0] == 0:
+                raise ValueError(f"Request for {requests[ri][0]!r}: empty input")
+            if X.shape[0] <= off:
+                raise ValueError(
+                    f"Request for {requests[ri][0]!r}: need more than "
+                    f"{off} rows (sequence warm-up), got {X.shape[0]}"
+                )
+        # rows-per-call stays a power of two and never exceeds max_rows
+        # (but must always cover at least one window + one output row)
+        T = min(
+            _next_pow2(max(x.shape[0] for x in rows)), _prev_pow2(self.max_rows)
+        )
+        T = max(T, _next_pow2(off + 1))
+        step = T - off
+        chunks: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        # per-request reassembly plan, built once here instead of the
+        # post-hoc per_req/valid dict churn the reassembly loop used to
+        # re-derive per call (each chunk yields rows [start+off, start+T))
+        req_plans: List[Tuple[int, np.ndarray, List[int], List[int], int]] = []
+        for ri, X in zip(req_ids, rows):
+            yv = requests[ri][2]
+            if yv is None:
+                Y = X
+            else:
+                Y = np.asarray(yv, np.float32)
+                if Y.shape != X.shape:
                     raise ValueError(
-                        f"Request for {requests[ri][0]!r}: expected (rows, {F}), "
-                        f"got {X.shape}"
+                        f"Request for {requests[ri][0]!r}: y shape {Y.shape} "
+                        f"must match X shape {X.shape}"
                     )
-                if X.shape[0] == 0:
-                    raise ValueError(f"Request for {requests[ri][0]!r}: empty input")
-                if X.shape[0] <= off:
-                    raise ValueError(
-                        f"Request for {requests[ri][0]!r}: need more than "
-                        f"{off} rows (sequence warm-up), got {X.shape[0]}"
-                    )
-            # rows-per-call stays a power of two and never exceeds max_rows
-            # (but must always cover at least one window + one output row)
-            T = min(
-                _next_pow2(max(x.shape[0] for x in rows)), _prev_pow2(self.max_rows)
-            )
-            T = max(T, _next_pow2(off + 1))
-            # chunk any request longer than one call; sequence chunks
-            # OVERLAP by the warm-up so no output rows are lost at chunk
-            # boundaries (each chunk yields rows [start+off, start+T))
-            step = T - off
-            chunks: List[Tuple[int, int, np.ndarray, np.ndarray]] = []
-            for ri, X in zip(req_ids, rows):
-                yv = requests[ri][2]
-                if yv is None:
-                    Y = X
-                else:
-                    Y = np.asarray(yv, np.float32)
-                    if Y.shape != X.shape:
-                        raise ValueError(
-                            f"Request for {requests[ri][0]!r}: y shape {Y.shape} "
-                            f"must match X shape {X.shape}"
-                        )
-                for start in range(0, X.shape[0] - off, step):
-                    chunks.append(
-                        (ri, start, X[start : start + T], Y[start : start + T])
-                    )
-            t_chunks = time.monotonic() if group_traces else 0.0
-            # slots[ci]: where chunk ci landed in the batched output —
-            # a flat index (single-device) or a (device, local-slot) pair
-            # (mesh routing)
-            slots: Dict[int, Any] = {}
-            if self._m_shard_rows is not None:
-                # per-bucket coalescing visibility: dispatches, request
-                # fan-in, and the coalesced batch-size distribution
-                blabel = bucket.label
-                self._m_bucket_calls.labels(blabel).inc()
-                self._m_bucket_reqs.labels(blabel).inc(len(req_ids))
-                self._m_bucket_batch.labels(blabel).record(float(len(chunks)))
+            cis: List[int] = []
+            valids: List[int] = []
+            for start in range(0, X.shape[0] - off, step):
+                xc = X[start : start + T]
+                cis.append(len(chunks))
+                valids.append(xc.shape[0] - off)
+                chunks.append((ri, xc, Y[start : start + T]))
+            # the already-converted array rides into
+            # ScoreResult.model_input, so the response path stops paying
+            # a second np.asarray(X, float32) per request
+            req_plans.append((ri, X, cis, valids, X.shape[0] - off))
+        run.req_plans = req_plans
+        run.n_chunks = len(chunks)
+        run.t_chunks = time.monotonic() if group_traces else 0.0
+        if self._m_shard_rows is not None:
+            # per-bucket coalescing visibility: dispatches, request
+            # fan-in, and the coalesced batch-size distribution
+            blabel = bucket.label
+            self._m_bucket_calls.labels(blabel).inc()
+            self._m_bucket_reqs.labels(blabel).inc(len(req_ids))
+            self._m_bucket_batch.labels(blabel).record(float(len(chunks)))
+        try:
             if self.mesh is None:
                 B = _next_pow2(len(chunks))
-                Xb = np.zeros((B, T, F), np.float32)
-                Yb = np.zeros((B, T, F), np.float32)
+                Xb, x_clean = self.arena.acquire((B, T, F))
+                run._bufs = (Xb,)  # attached NOW: a failed second acquire
+                # must not strand the first buffer outside the arena
+                Yb, y_clean = self.arena.acquire((B, T, F))
+                run._bufs = (Xb, Yb)
                 idx = np.zeros((B,), np.int32)
+                # slots[ci]: where chunk ci landed in the batched output —
+                # a flat index here, a (device, local-slot) pair under
+                # mesh routing
+                slots: List[Any] = list(range(len(chunks)))
                 routed0 = 0
-                for ci, (ri, _start, xc, yc) in enumerate(chunks):
-                    Xb[ci, : xc.shape[0]] = xc
-                    Yb[ci, : yc.shape[0]] = yc
+                for ci, (ri, xc, yc) in enumerate(chunks):
+                    n = xc.shape[0]
+                    Xb[ci, :n] = xc
+                    Yb[ci, :n] = yc
+                    if n < T:
+                        if not x_clean:
+                            Xb[ci, n:] = 0.0
+                        if not y_clean:
+                            Yb[ci, n:] = 0.0
                     idx[ci] = self._index[requests[ri][0]][1]
-                    slots[ci] = ci
-                    routed0 += xc.shape[0]
+                    routed0 += n
+                if not x_clean:
+                    Xb[len(chunks):] = 0.0
+                if not y_clean:
+                    Yb[len(chunks):] = 0.0
                 if self._m_shard_rows is not None:
                     self._m_shard_rows.labels("0").inc(routed0)
                     self._m_shard_pad.labels("0").inc(B * T - routed0)
                     self._m_shard_reqs.labels("0").inc(len(chunks))
-                score_fn = bucket.score_batch
+                run.score_fn = bucket.score_batch
             else:
                 # route each chunk to the shard owning its model: the
                 # stacked leading axis is split into n_shards contiguous
                 # blocks of shard_size (parallel/mesh.shard_model_axis)
                 D, shard = bucket.n_shards, bucket.shard_size
                 per_dev: List[List[int]] = [[] for _ in range(D)]
-                for ci, (ri, _start, _xc, _yc) in enumerate(chunks):
+                for ci, (ri, _xc, _yc) in enumerate(chunks):
                     per_dev[self._index[requests[ri][0]][1] // shard].append(ci)
                 Bl = _next_pow2(max(1, max(len(c) for c in per_dev)))
-                Xb = np.zeros((D, Bl, T, F), np.float32)
-                Yb = np.zeros((D, Bl, T, F), np.float32)
+                Xb, x_clean = self.arena.acquire((D, Bl, T, F))
+                run._bufs = (Xb,)
+                Yb, y_clean = self.arena.acquire((D, Bl, T, F))
+                run._bufs = (Xb, Yb)
                 idx = np.zeros((D, Bl), np.int32)
-                for d, cis in enumerate(per_dev):
+                slots = [None] * len(chunks)
+                for d, dev_cis in enumerate(per_dev):
                     routed_d = 0
-                    for j, ci in enumerate(cis):
-                        ri, _start, xc, yc = chunks[ci]
-                        Xb[d, j, : xc.shape[0]] = xc
-                        Yb[d, j, : yc.shape[0]] = yc
+                    for j, ci in enumerate(dev_cis):
+                        ri, xc, yc = chunks[ci]
+                        n = xc.shape[0]
+                        Xb[d, j, :n] = xc
+                        Yb[d, j, :n] = yc
+                        if n < T:
+                            if not x_clean:
+                                Xb[d, j, n:] = 0.0
+                            if not y_clean:
+                                Yb[d, j, n:] = 0.0
                         idx[d, j] = self._index[requests[ri][0]][1] - d * shard
                         slots[ci] = (d, j)
-                        routed_d += xc.shape[0]
+                        routed_d += n
+                    if not x_clean:
+                        Xb[d, len(dev_cis):] = 0.0
+                    if not y_clean:
+                        Yb[d, len(dev_cis):] = 0.0
                     if self._m_shard_rows is not None:
                         # every device executes Bl * T rows regardless of
                         # how many are real: the routed/padded split is the
@@ -843,98 +1289,111 @@ class ModelBank:
                         sl = str(d)
                         self._m_shard_rows.labels(sl).inc(routed_d)
                         self._m_shard_pad.labels(sl).inc(Bl * T - routed_d)
-                        self._m_shard_reqs.labels(sl).inc(len(cis))
-                score_fn = bucket.score_batch_sharded
-            if group_traces is None:
-                out = score_fn(idx, Xb, Yb)
-                t_pad = t_exec = 0.0
-                profile_dir = None
-            else:
-                t_pad = time.monotonic()
-                # optional JAX profiler capture of exactly this dispatch
-                # (utils/profiling.maybe_profile, armed by
-                # GORDO_PROFILE_DIR): the profiler trace directory is
-                # named by the request's trace id, so the span tree and
-                # the op-level timeline share one identity — the span's
-                # ``profile`` attribute links them
-                profile_dir = None
-                prof: Any = contextlib.nullcontext()
-                prof_root = os.environ.get("GORDO_PROFILE_DIR")
-                if prof_root:
-                    from gordo_components_tpu.utils.profiling import maybe_profile
+                        self._m_shard_reqs.labels(sl).inc(len(dev_cis))
+                run.score_fn = bucket.score_batch_sharded
+        except BaseException:
+            run.release(self.arena)
+            raise
+        run.Xb, run.Yb, run.idx = Xb, Yb, idx
+        run.slots = slots
+        run.t_pad = time.monotonic() if group_traces else 0.0
+        return run
 
-                    prof_name = f"serve-{group_traces[0].trace_id}"
-                    profile_dir = os.path.join(prof_root, prof_name)
-                    prof = maybe_profile(prof_name)
-                with prof:
-                    out = score_fn(idx, Xb, Yb)
-                    # fence: device execution ends HERE, so the
-                    # device_execute span measures XLA, not the host-side
-                    # transfer/reassembly that follows
-                    jax.block_until_ready(out)
-                t_exec = time.monotonic()
+    def _dispatch(self, run: _GroupRun) -> None:
+        """Pipeline stage 2 — async device dispatch.
+
+        The XLA call returns device arrays WITHOUT fetching them (JAX
+        async dispatch), so the host is free to pad the next group and
+        fetch the previous one while this group executes; the device
+        window closes at :meth:`_postprocess`'s fence."""
+        _FP_SCORE.fire()
+        run.t_dispatch = time.monotonic()
+        prof_root = (
+            os.environ.get("GORDO_PROFILE_DIR") if run.group_traces else None
+        )
+        if prof_root:
+            # JAX profiler capture of exactly this dispatch
+            # (utils/profiling.maybe_profile): the profiler trace
+            # directory is named by the request's trace id, so the span
+            # tree and the op-level timeline share one identity — the
+            # span's ``profile`` attribute links them. The capture must
+            # SEE the execution, so this opt-in debugging path fences
+            # inside the profile context, serializing only this group.
+            from gordo_components_tpu.utils.profiling import maybe_profile
+
+            prof_name = f"serve-{run.group_traces[0].trace_id}"
+            run.profile_dir = os.path.join(prof_root, prof_name)
+            with maybe_profile(prof_name):
+                run.out = run.score_fn(run.idx, run.Xb, run.Yb)
+                jax.block_until_ready(run.out)
+            run.t_ready = run.t_device_done = time.monotonic()
+        else:
+            run.out = run.score_fn(run.idx, run.Xb, run.Yb)
+
+    def _postprocess(
+        self,
+        run: _GroupRun,
+        requests: Sequence[Tuple[str, np.ndarray, Optional[np.ndarray]]],
+        results: List[Any],
+        traces: Optional[Sequence[Any]],
+    ) -> None:
+        """Pipeline stage 3 — fence, fetch, reassemble, release."""
+        try:
+            if not run.t_ready:
+                try:
+                    # fence: this group's device window ends HERE (a
+                    # device-side error surfaces here too, after the
+                    # timestamp, so overlap accounting stays sane)
+                    jax.block_until_ready(run.out)
+                finally:
+                    run.t_ready = time.monotonic()
             # one transfer for all five outputs (device_get batches the
             # D2H copies) instead of five blocking np.asarray round-trips
-            recon, diff, scaled, tot_u, tot_s = jax.device_get(out)
-            # reassemble per-request: each chunk contributes its VALID
-            # output rows (rows computed from real, unpadded input)
-            per_req: Dict[int, List[int]] = {}
-            valid: Dict[int, int] = {}
-            for ci, (ri, _s, xc, _y) in enumerate(chunks):
-                per_req.setdefault(ri, []).append(ci)
-                valid[ci] = xc.shape[0] - off
-            for ri, cis in per_req.items():
-                name, X, _yv = requests[ri]
-                n_out = X.shape[0] - off
+            outs = jax.device_get(run.out)
+            slots = run.slots
+            for ri, X_conv, cis, valids, n_out in run.req_plans:
                 if len(cis) == 1:
-                    # single-chunk request (the serving-path norm): one
-                    # sliced copy instead of a concatenate per output
-                    # array — the concatenate machinery (list build +
-                    # dtype resolve) was the top host cost in the
-                    # coalesced hot loop (profiled round 5). The copy is
-                    # deliberate: a view would pin the whole (B, T, ...)
-                    # batch output alive as long as any one result is
-                    # held, and would be read-only where the multi-chunk
-                    # path returns writable arrays
-                    s0 = slots[cis[0]]
-                    cat = lambda arr: arr[s0][:n_out].copy()
+                    vals = _slice_single(outs, slots[cis[0]], n_out)
                 else:
-                    cat = lambda arr: np.concatenate(
-                        [arr[slots[ci]][: valid[ci]] for ci in cis], axis=0
-                    )[:n_out]
+                    vals = _concat_chunks(outs, slots, cis, valids, n_out)
                 results[ri] = ScoreResult(
-                    tags=self._tags[name],
-                    model_input=np.asarray(X, np.float32),
-                    model_output=cat(recon),
-                    diff=cat(diff),
-                    scaled=cat(scaled),
-                    total_unscaled=cat(tot_u),
-                    total_scaled=cat(tot_s),
-                    offset=off,
+                    tags=self._tags[requests[ri][0]],
+                    model_input=X_conv,
+                    model_output=vals[0],
+                    diff=vals[1],
+                    scaled=vals[2],
+                    total_unscaled=vals[3],
+                    total_scaled=vals[4],
+                    offset=run.off,
                 )
-            if group_traces:
+            if run.group_traces:
                 # the stage boundaries are per coalesced GROUP: every
                 # traced request in it gets the same span timestamps —
                 # per-request attribution of the shared batch's cost,
                 # which is exactly what coalescing makes invisible in a
                 # plain latency histogram
                 t_done = time.monotonic()
-                for ri in req_ids:
+                blabel = run.bucket.label
+                for ri in run.req_ids:
                     tr = traces[ri]  # type: ignore[index]
                     if tr is None:
                         continue
                     tr.add_span(
-                        "coalesce", t_group, t_chunks,
-                        bucket=bucket.label, requests=len(req_ids),
-                        chunks=len(chunks),
+                        "coalesce", run.t_group, run.t_chunks,
+                        bucket=blabel, requests=len(run.req_ids),
+                        chunks=run.n_chunks,
                     )
-                    tr.add_span("pad", t_chunks, t_pad)
-                    exec_attrs: Dict[str, Any] = {"bucket": bucket.label}
-                    if profile_dir is not None:
-                        exec_attrs["profile"] = profile_dir
-                    tr.add_span("device_execute", t_pad, t_exec, **exec_attrs)
-                    tr.add_span("postprocess", t_exec, t_done)
-        return results  # type: ignore[return-value]
+                    tr.add_span("pad", run.t_chunks, run.t_pad)
+                    exec_attrs: Dict[str, Any] = {"bucket": blabel}
+                    if run.profile_dir is not None:
+                        exec_attrs["profile"] = run.profile_dir
+                    tr.add_span(
+                        "device_execute", run.t_dispatch, run.t_ready,
+                        **exec_attrs,
+                    )
+                    tr.add_span("postprocess", run.t_ready, t_done)
+        finally:
+            run.release(self.arena)
 
 
 # --------------------------------------------------------------------- #
@@ -1015,6 +1474,14 @@ class BatchingEngine:
         self.max_queue = int(max_queue)
         self._queue: "asyncio.Queue[_Pending]" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # group-isolation capability of the current bank (score_many's
+        # ``return_exceptions``), probed once per bank object: proxies
+        # and stubs with the minimal score_many(requests) signature keep
+        # the legacy whole-batch retry path. Held as a weakref: a strong
+        # reference would pin a /reload-replaced bank's HBM-resident
+        # params (and arena pool) until the next batch re-probes.
+        self._partial_bank: Any = None
+        self._partial_ok = False
         self.stats = {
             "requests": 0,
             "batches": 0,
@@ -1050,8 +1517,6 @@ class BatchingEngine:
             # weakref: the collector lives as long as the registry (which
             # may be process-global); it must not pin a discarded engine —
             # and, through engine.bank, a whole bank's device state
-            import weakref
-
             ref = weakref.ref(self)
 
             def collect():
@@ -1265,10 +1730,26 @@ class BatchingEngine:
                     )
             requests = [(p.name, p.X, p.y) for p in batch]
             try:
+                if self._supports_partial():
+                    # group-isolated scoring: a failed bucket group (or a
+                    # mid-pipeline deadline expiry) comes back as
+                    # per-request exception entries while every other
+                    # group's results survive — the healthy majority of
+                    # a coalesced batch is never rescored
+                    results = await loop.run_in_executor(
+                        None,
+                        functools.partial(
+                            self.bank.score_many,
+                            requests,
+                            traces=[p.trace for p in batch] if traced else None,
+                            deadline=batch_deadline,
+                            return_exceptions=True,
+                        ),
+                    )
                 # the traces/deadline arguments only ride along when
                 # actually present: bank proxies/stubs with the minimal
                 # score_many(requests) signature keep working
-                if batch_deadline is not None:
+                elif batch_deadline is not None:
                     results = await loop.run_in_executor(
                         None,
                         functools.partial(
@@ -1289,69 +1770,108 @@ class BatchingEngine:
                     )
             except Exception:
                 # one bad request must not poison the batch: retry each
-                # request alone so errors land only on their own future
+                # request alone so errors land only on their own future.
+                # A DeadlineExceeded from score_many (the batch's
+                # earliest budget ran out between group dispatches)
+                # lands here too: _retry_one re-judges each pending
+                # against its OWN deadline — expired ones 504 without
+                # another dispatch, the rest re-score individually
                 for p in batch:
-                    # a DeadlineExceeded from score_many (the batch's
-                    # earliest budget ran out between group dispatches)
-                    # lands here too: re-judge each pending against its
-                    # OWN deadline — expired ones 504 without another
-                    # dispatch, the rest re-score individually
-                    if p.deadline is not None and p.deadline.expired():
-                        self.stats["deadline_expired"] += 1
-                        if p.trace is not None:
-                            now = time.monotonic()
-                            p.trace.add_span(
-                                "deadline_expired", p.enqueued, now,
-                                error=True, where="retry",
-                            )
-                        if not p.future.done():
-                            p.future.set_exception(
-                                DeadlineExceeded(
-                                    f"deadline expired before retry "
-                                    f"(rid={p.request_id}, budget "
-                                    f"{p.deadline.budget_s * 1e3:.0f}ms)"
-                                )
-                            )
-                        self.service.record(time.monotonic() - p.enqueued)
-                        continue
-                    try:
-                        # carry the trace into the retry ONLY if the
-                        # failed batch call never recorded stage spans for
-                        # this request (its bucket group died before the
-                        # span block) — a request whose group completed
-                        # before another group raised would otherwise get
-                        # a duplicate coalesce/pad/execute/postprocess set
-                        retry_trace = p.trace
-                        if retry_trace is not None and any(
-                            s.name == "device_execute"
-                            for s in retry_trace.spans
-                        ):
-                            retry_trace = None
-                        if retry_trace is not None:
-                            r = await loop.run_in_executor(
-                                None, self.bank.score,
-                                p.name, p.X, p.y, retry_trace,
-                            )
-                        else:
-                            r = await loop.run_in_executor(
-                                None, self.bank.score, p.name, p.X, p.y
-                            )
-                    except Exception as exc:
-                        # rid ties this failure back to the access-log
-                        # line (and the client header) that admitted it
-                        logger.warning(
-                            "engine request for %r failed (rid=%s): %s",
-                            p.name, p.request_id, exc,
-                        )
-                        if not p.future.done():
-                            p.future.set_exception(exc)
-                    else:
-                        if not p.future.done():
-                            p.future.set_result(r)
-                    self.service.record(time.monotonic() - p.enqueued)
+                    await self._retry_one(loop, p)
                 continue
             done = time.monotonic()
+            failed: List[_Pending] = []
             for p, r in zip(batch, results):
+                if isinstance(r, Exception):
+                    # only the owning group's requests walk the
+                    # per-request recovery path
+                    failed.append(p)
+                    continue
                 if not p.future.done():
                     p.future.set_result(r)
                 self.service.record(done - p.enqueued)
+            # healthy futures resolve BEFORE any retry work: a failed
+            # group's sequential per-request rescores must not sit in
+            # front of already-computed results later in the batch order
+            for p in failed:
+                await self._retry_one(loop, p)
+
+    def _supports_partial(self) -> bool:
+        """Whether the current bank's ``score_many`` takes
+        ``return_exceptions`` (probed once per bank object — reload swaps
+        banks, and signature inspection is not hot-loop cheap)."""
+        bank = self.bank
+        prev = (
+            self._partial_bank()
+            if isinstance(self._partial_bank, weakref.ref)
+            else self._partial_bank
+        )
+        if bank is not prev:
+            try:
+                self._partial_bank = weakref.ref(bank)
+            except TypeError:  # non-weakref-able stub: strong ref is fine
+                self._partial_bank = bank
+            try:
+                self._partial_ok = (
+                    "return_exceptions"
+                    in inspect.signature(bank.score_many).parameters
+                )
+            except (TypeError, ValueError):
+                self._partial_ok = False
+        return self._partial_ok
+
+    async def _retry_one(self, loop, p: _Pending) -> None:
+        """Per-request recovery after its batch (or just its bucket
+        group) failed: re-judge the pending against its own deadline,
+        then re-score it alone so an error lands only on its own
+        future."""
+        if p.deadline is not None and p.deadline.expired():
+            self.stats["deadline_expired"] += 1
+            if p.trace is not None:
+                now = time.monotonic()
+                p.trace.add_span(
+                    "deadline_expired", p.enqueued, now,
+                    error=True, where="retry",
+                )
+            if not p.future.done():
+                p.future.set_exception(
+                    DeadlineExceeded(
+                        f"deadline expired before retry "
+                        f"(rid={p.request_id}, budget "
+                        f"{p.deadline.budget_s * 1e3:.0f}ms)"
+                    )
+                )
+            self.service.record(time.monotonic() - p.enqueued)
+            return
+        try:
+            # carry the trace into the retry ONLY if the failed batch
+            # call never recorded stage spans for this request (its
+            # bucket group died before the span block) — a request whose
+            # group completed before another group raised would otherwise
+            # get a duplicate coalesce/pad/execute/postprocess set
+            retry_trace = p.trace
+            if retry_trace is not None and any(
+                s.name == "device_execute" for s in retry_trace.spans
+            ):
+                retry_trace = None
+            if retry_trace is not None:
+                r = await loop.run_in_executor(
+                    None, self.bank.score, p.name, p.X, p.y, retry_trace,
+                )
+            else:
+                r = await loop.run_in_executor(
+                    None, self.bank.score, p.name, p.X, p.y
+                )
+        except Exception as exc:
+            # rid ties this failure back to the access-log line (and
+            # the client header) that admitted it
+            logger.warning(
+                "engine request for %r failed (rid=%s): %s",
+                p.name, p.request_id, exc,
+            )
+            if not p.future.done():
+                p.future.set_exception(exc)
+        else:
+            if not p.future.done():
+                p.future.set_result(r)
+        self.service.record(time.monotonic() - p.enqueued)

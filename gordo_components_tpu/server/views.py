@@ -429,6 +429,12 @@ async def server_stats(request: web.Request) -> web.Response:
     bank = request.app.get("bank")
     if bank is not None:
         body["bank_models"] = len(bank)
+        pipeline = getattr(bank, "pipeline_stats", None)
+        if pipeline is not None:
+            # the scoring pipeline's health at a glance: in-flight
+            # window, padded-buffer arena hit rate, and the measured
+            # host/device overlap ratio across multi-group calls
+            body["bank_pipeline"] = pipeline()
     quarantine = request.app.get("quarantine")
     if quarantine is not None:
         # the degraded-mode surface: which models the breaker evicted
@@ -532,6 +538,7 @@ async def reload_models(request: web.Request) -> web.Response:
         if app.get("bank_enabled"):
             from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
 
+            cfg = app.get("bank_config", {})
             bank = await loop.run_in_executor(
                 None,
                 functools.partial(
@@ -541,6 +548,10 @@ async def reload_models(request: web.Request) -> web.Response:
                     # same registry across reloads: the family children
                     # persist, so routed/padded counters stay monotonic
                     registry=app.get("metrics"),
+                    # same pipeline window/arena budget the app booted
+                    # with — a reload must not silently reset tuning
+                    inflight=cfg.get("inflight"),
+                    arena_max_mb=cfg.get("arena_max_mb"),
                 ),
             )
             # the rebuilt bank's jit closures are cold: re-warm them here,

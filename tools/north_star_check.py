@@ -208,6 +208,13 @@ def run_check(
         ),
         "queue_wait": engine.queue_wait.snapshot(),
     }
+    # scoring-pipeline evidence at scale (ISSUE 5): in-flight window,
+    # padded-buffer arena hit rate, and the host/device overlap ratio
+    # (non-null only when the request mix spans several buckets — the
+    # single-architecture north-star fleet coalesces into one group).
+    # The arena must never leak a buffer across the whole serve phase.
+    out["pipeline"] = bank.pipeline_stats()
+    assert out["pipeline"]["arena"]["outstanding"] == 0, out["pipeline"]
     # ---- 6b. overload: offered load past capacity must shed (429 path)
     # with bounded latency, not grow the queue without bound. Clients
     # hammer in closed loops at ~4x the concurrency the engine coalesces,
