@@ -38,8 +38,11 @@ hotloop:
 	$(PYTHON) -m pytest tests/ -q -m hotloop --continue-on-collection-errors
 
 # perf-guard lane: every hot-loop overhead guard PLUS the pipelined-vs-
-# serial parity+no-slower check (tests/test_bank_pipeline.py) — the
-# scoring pipeline must never regress below the serial path it replaced
+# serial parity+no-slower check (tests/test_bank_pipeline.py) PLUS the
+# banked-kernel legs (tests/test_banked_kernel.py parity sweep and
+# tests/test_bank_quantized.py fused-kernel>=XLA-at-equal-dtype) — the
+# scoring pipeline must never regress below the serial path it replaced,
+# and the fused kernel must never regress below the XLA epilogue
 perf-guard:
 	$(PYTHON) -m pytest tests/ -q -m "hotloop or perfguard" --continue-on-collection-errors
 

@@ -31,6 +31,25 @@ logger = logging.getLogger(__name__)
 _initialized = False
 
 
+def _runtime_is_initialized(jax_mod) -> bool:
+    """Whether the multi-controller runtime is already up, across jax
+    versions: ``jax.distributed.is_initialized`` arrived after 0.4.37 —
+    on older jax the distributed service's global state carries the same
+    answer (``client`` is set by ``initialize()`` and nothing else).
+    Must not touch ``jax.process_count()``/``jax.devices()``: those
+    initialize the XLA backend, after which ``initialize()`` refuses to
+    run."""
+    probe = getattr(jax_mod.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # pragma: no cover - future jax moving the module
+        return False
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -48,8 +67,8 @@ def initialize_distributed(
 
     # NB: jax.process_count()/jax.devices() would initialize the XLA
     # backend, after which jax.distributed.initialize() refuses to run —
-    # only is_initialized() is safe to probe here.
-    if _initialized or jax.distributed.is_initialized():
+    # only the initialized-probe is safe here.
+    if _initialized or _runtime_is_initialized(jax):
         _initialized = True
         return jax.process_count() > 1
 

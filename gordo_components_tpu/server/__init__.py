@@ -260,6 +260,8 @@ def build_app(
     quarantine_threshold: Optional[int] = None,
     bank_inflight: Optional[int] = None,
     arena_max_mb: Optional[float] = None,
+    bank_dtype: Optional[str] = None,
+    bank_kernel: Optional[str] = None,
 ) -> web.Application:
     """App factory: loads the artifact(s) under ``model_dir`` once.
 
@@ -399,6 +401,13 @@ def build_app(
         # env/default resolution inside ModelBank)
         "inflight": bank_inflight,
         "arena_max_mb": arena_max_mb,
+        # precision/capacity knobs (docs/operations.md "Precision &
+        # capacity tuning"): storage dtype for the stacked weights (env
+        # GORDO_BANK_DTYPE) and the banked-epilogue dispatch mode (env
+        # GORDO_BANK_KERNEL) — remembered so /reload rebuilds the bank
+        # at the same precision the app booted with
+        "bank_dtype": bank_dtype,
+        "bank_kernel": bank_kernel,
     }
     app["bank_mesh"] = mesh  # reload (views.py) rebuilds under the same mesh
     if use_bank:
@@ -408,10 +417,17 @@ def build_app(
             registry=registry,
             inflight=bank_inflight,
             arena_max_mb=arena_max_mb,
+            bank_dtype=bank_dtype,
+            bank_kernel=bank_kernel,
         )
         # expose the bank even when nothing banked: /models reports the
         # coverage (banked vs per-model fallback, with reasons)
         app["bank"] = bank
+        # store the RESOLVED precision/kernel, not the requested (often
+        # None) values: a /reload must rebuild at what the app actually
+        # booted with, even if the env changed underneath it since
+        app["bank_config"]["bank_dtype"] = bank.bank_dtype
+        app["bank_config"]["bank_kernel"] = bank.kernel_mode
         if len(bank):
 
             async def _start_engine(app: web.Application) -> None:

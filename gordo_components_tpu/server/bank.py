@@ -49,6 +49,16 @@ from gordo_components_tpu.models.anomaly.diff import (
 from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.models.train_core import _next_pow2
 from gordo_components_tpu.observability import get_registry
+from gordo_components_tpu.ops.pallas_score import (
+    banked_anomaly_score,
+    resolve_bank_kernel_mode,
+)
+from gordo_components_tpu.ops.quantize import (
+    dequantize_params,
+    normalize_bank_dtype,
+    quantize_stacked,
+    tree_weight_bytes,
+)
 from gordo_components_tpu.ops.scaler import ScalerParams
 from gordo_components_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from gordo_components_tpu.resilience.faults import faultpoint
@@ -56,12 +66,17 @@ from gordo_components_tpu.server.arena import PaddedArena
 
 logger = logging.getLogger(__name__)
 
-# chaos sites (tests/test_chaos.py): bucket stack/compile, batched scoring
-# dispatch, and engine admission. Module-level points so the disabled cost
-# on the serving hot loop is one attribute check (see the 5% guard test).
+# chaos sites (tests/test_chaos.py): bucket stack/compile, low-precision
+# weight quantization, batched scoring dispatch, and engine admission.
+# Module-level points so the disabled cost on the serving hot loop is one
+# attribute check (see the 5% guard test).
 _FP_FINALIZE = faultpoint("bank.finalize")
+_FP_QUANTIZE = faultpoint("bank.quantize")
 _FP_SCORE = faultpoint("bank.score")
 _FP_ENGINE_QUEUE = faultpoint("engine.queue")
+
+# short dtype tags for bucket metric labels (bounded, readable)
+_DTYPE_TAGS = {"bfloat16": "bf16", "int8": "int8"}
 
 
 # --------------------------------------------------------------------- #
@@ -228,6 +243,8 @@ class _Bucket:
         lookback: int = 1,
         target_offset: int = 0,
         mesh=None,
+        bank_dtype: str = "float32",
+        kernel_mode: str = "jnp",
     ):
         self.kind = kind
         self.n_features = n_features
@@ -236,6 +253,16 @@ class _Bucket:
         self.registry_type = registry_type
         self.lookback = int(lookback)
         self.target_offset = int(target_offset)
+        # low-precision weight bank (ops/quantize.py): the REQUESTED
+        # storage dtype; ``effective_dtype`` records what finalize
+        # actually shipped to HBM (a failed quantization falls back to
+        # fp32 for this bucket only, with the reason in quantize_error)
+        self.bank_dtype = bank_dtype
+        self.kernel_mode = kernel_mode
+        self.effective_dtype = "float32"
+        self.quantize_error: Optional[str] = None
+        self.weight_bytes = 0  # stacked params bytes as stored (HBM cost)
+        self.weight_bytes_fp32 = 0  # same stack at fp32 (the baseline)
         # short stable id for per-bucket metric labels (the full bucket key
         # is a JSON blob; labels need something bounded and readable). The
         # readable prefix alone is NOT unique — buckets differing only in
@@ -245,6 +272,14 @@ class _Bucket:
         self.label = f"{registry_type}:{kind}:f{n_features}:l{self.lookback}"
         if self.target_offset:
             self.label += f":o{self.target_offset}"
+        if bank_dtype != "float32":
+            # storage dtype in the label: a bf16 bank and an fp32 bank
+            # compile DIFFERENT programs over different HBM layouts and
+            # must not blend into one metric series (bucket keying by
+            # dtype; the tag stays even if quantization falls back, so
+            # the fallback is visible as a q-tagged bucket serving fp32
+            # alongside the gordo_bank_quantize_fallback_total counter)
+            self.label += f":q{_DTYPE_TAGS.get(bank_dtype, bank_dtype)}"
         if factory_kwargs or compute_dtype != "float32":
             import hashlib
 
@@ -294,6 +329,27 @@ class _Bucket:
         stacked = jax.tree.map(
             lambda *leaves: np.stack(leaves), *[e.params for e in entries]
         )
+        self.weight_bytes_fp32 = tree_weight_bytes(stacked)
+        self.effective_dtype = "float32"
+        if self.bank_dtype != "float32":
+            # low-precision weight bank (ops/quantize.py): HBM holds the
+            # bf16/int8 stack, the compiled program dequantizes the
+            # gathered member back to fp32. A failed quantization is an
+            # IMPAIRMENT of capacity, not of correctness — this bucket
+            # falls back to fp32 storage (counted by the bank) instead of
+            # failing the whole build.
+            try:
+                _FP_QUANTIZE.fire()
+                stacked = quantize_stacked(stacked, self.bank_dtype)
+                self.effective_dtype = self.bank_dtype
+            except Exception as exc:
+                self.quantize_error = f"{type(exc).__name__}: {exc}"
+                logger.warning(
+                    "Bucket %s: %s quantization failed (%s); storing fp32 "
+                    "for this bucket",
+                    self.label, self.bank_dtype, exc,
+                )
+        self.weight_bytes = tree_weight_bytes(stacked)
         self.params = jax.device_put(stacked, sharding)
         self.scalers = tuple(
             jax.device_put(np.stack([getattr(e, f) for e in entries]), sharding)
@@ -303,13 +359,20 @@ class _Bucket:
             self.n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
         )
         lookback, t_off, off = self.lookback, self.target_offset, self.offset
+        dequant = self.effective_dtype != "float32"
+        kernel_mode = self.kernel_mode
 
-        def one(params, in_shift, in_scale, err_shift, err_scale, i, x, y):
-            # i: () int32 into the (local) stack; x/y: (T, F) raw-space
-            from gordo_components_tpu.ops.pallas_score import _jnp_score
+        def forward(params, in_shift, in_scale, i, x, y):
+            # i: () int32 into the (local) stack; x/y: (T, F) raw-space;
+            # returns (recon, target) — the epilogue runs batched below
             from gordo_components_tpu.ops.windows import sliding_windows
 
             p = jax.tree.map(lambda a: a[i], params)
+            if dequant:
+                # per-member dequantization INSIDE the compiled program:
+                # only the gathered member's weights round-trip to fp32,
+                # compute accumulates in fp32 throughout
+                p = dequantize_params(p)
             xs = (x - in_shift[i]) * in_scale[i]
             ys = (y - in_shift[i]) * in_scale[i]
             if lookback > 1:
@@ -321,22 +384,24 @@ class _Bucket:
             else:
                 recon = module.apply(p, xs)
                 target = ys
-            # same epilogue definition as the per-model path (XLA fuses
-            # it into the batched program here; see ops/pallas_score.py)
-            diff, scaled, tot_u, tot_s = _jnp_score(
-                target, recon, err_shift[i], err_scale[i]
-            )
-            return recon, diff, scaled, tot_u, tot_s
+            return recon, target
 
         if self.mesh is None:
 
             def score(params, in_shift, in_scale, err_shift, err_scale, idx, X, Y):
-                # idx: (B,) int32; X/Y: (B, T, F) raw-space
-                return jax.vmap(
-                    lambda i, x, y: one(
-                        params, in_shift, in_scale, err_shift, err_scale, i, x, y
-                    )
+                # idx: (B,) int32; X/Y: (B, T, F) raw-space. The model
+                # forward vmaps per member; the scoring epilogue (scale ->
+                # reconstruction error -> row norms) runs over the WHOLE
+                # batch in one banked pass — the Pallas kernel's
+                # (member, row-tile) grid on TPU, identical jnp math
+                # elsewhere (ops/pallas_score.banked_anomaly_score)
+                recon, target = jax.vmap(
+                    lambda i, x, y: forward(params, in_shift, in_scale, i, x, y)
                 )(idx, X, Y)
+                diff, scaled, tot_u, tot_s = banked_anomaly_score(
+                    target, recon, err_shift, err_scale, idx, mode=kernel_mode
+                )
+                return recon, diff, scaled, tot_u, tot_s
 
         else:
             from jax.sharding import PartitionSpec as P
@@ -351,11 +416,16 @@ class _Bucket:
                 # idx: (D, Blocal) LOCAL indices; X/Y: (D, Blocal, T, F);
                 # leading axis sharded over the mesh — each device scores
                 # its own sub-batch against its local (shard_size, ...)
-                # params block; no collectives
+                # params block; no collectives. The banked epilogue runs
+                # per device on the local sub-batch with the LOCAL scaler
+                # stack — the gather indices are already shard-local.
                 def local(p, ish, isc, esh, esc, i, x, y):
-                    out = jax.vmap(
-                        lambda ii, xx, yy: one(p, ish, isc, esh, esc, ii, xx, yy)
+                    recon, target = jax.vmap(
+                        lambda ii, xx, yy: forward(p, ish, isc, ii, xx, yy)
                     )(i[0], x[0], y[0])
+                    out = (recon,) + banked_anomaly_score(
+                        target, recon, esh, esc, i[0], mode=kernel_mode
+                    )
                     return jax.tree.map(lambda t: t[None], out)
 
                 # check_vma off: the program is collective-free by design
@@ -522,9 +592,28 @@ class ModelBank:
         registry=None,
         inflight: Optional[int] = None,
         arena_max_mb: Optional[float] = None,
+        bank_dtype: Optional[str] = None,
+        bank_kernel: Optional[str] = None,
     ):
         self.max_rows = int(max_rows_per_call)
         self.mesh = mesh
+        # low-precision weight bank (ops/quantize.py): storage dtype for
+        # the stacked bucket params (env GORDO_BANK_DTYPE, default
+        # float32 — the bitwise-parity baseline; bf16 halves and int8
+        # ~quarters HBM per member, with the error budget documented in
+        # docs/operations.md "Precision & capacity tuning")
+        if bank_dtype is None:
+            bank_dtype = os.environ.get("GORDO_BANK_DTYPE", "float32")
+        self.bank_dtype = normalize_bank_dtype(bank_dtype)
+        # banked epilogue dispatch (env GORDO_BANK_KERNEL, default auto:
+        # the fused Pallas kernel on TPU, identical jnp math elsewhere) —
+        # resolved ONCE here, baked into every bucket's compiled program
+        self.kernel_mode = resolve_bank_kernel_mode(bank_kernel)
+        # bucket label -> reason, for buckets whose low-precision
+        # quantization failed and fell back to fp32 storage (capacity
+        # impairment, surfaced via /stats bank_capacity + the
+        # gordo_bank_quantize_fallback_total counter)
+        self.quantize_fallbacks: Dict[str, str] = {}
         # pipeline depth: how many bucket groups may be in flight on the
         # device at once (env GORDO_BANK_INFLIGHT, default 2). While
         # group k executes, group k+1 is padded on the host and group
@@ -607,6 +696,12 @@ class ModelBank:
                 ("bucket",),
                 lo=1.0,
                 hi=1e5,
+            )
+            self._m_quant_fallback = registry.counter(
+                "gordo_bank_quantize_fallback_total",
+                "Bucket quantizations that failed and fell back to fp32 "
+                "storage (capacity impairment, not a correctness one)",
+                ("bucket",),
             )
             # weakref: these read-through closures live in a potentially
             # process-global registry; a strong self capture would pin a
@@ -701,13 +796,48 @@ class ModelBank:
                 )
 
             registry.collector(_pipeline_collect, key="bank_pipeline")
+
+            def _capacity_collect():
+                # per-dtype HBM weight bytes + models-per-GB, read from
+                # the live buckets at render time (gauges are point-in-
+                # time: a /reload's replacement collector under the same
+                # key simply takes over). One capacity_stats() call is
+                # the single source for both series — no second
+                # aggregation to drift from it.
+                bank = ref()
+                if bank is None:
+                    return ()
+                cap = bank.capacity_stats()
+                rows = [
+                    (
+                        "gordo_bank_weight_bytes", "gauge",
+                        "Stacked bank weight bytes resident in HBM, by "
+                        "storage dtype",
+                        {"dtype": d}, nbytes,
+                    )
+                    for d, nbytes in sorted(
+                        cap["weight_bytes_by_dtype"].items()
+                    )
+                ]
+                if cap["models_per_gb"] is not None:
+                    rows.append(
+                        (
+                            "gordo_bank_models_per_gb", "gauge",
+                            "Bank members per GB of stacked-weight HBM at "
+                            "the current dtype mix",
+                            {}, cap["models_per_gb"],
+                        )
+                    )
+                return tuple(rows)
+
+            registry.collector(_capacity_collect, key="bank_capacity")
         else:
-            # all six, not just the one score_many guards on: a future
+            # all of them, not just the one score_many guards on: a future
             # call site guarding on its own attribute must get None, not
             # AttributeError only in the registry=False configuration
             self._m_shard_rows = self._m_shard_pad = self._m_shard_reqs = None
             self._m_bucket_calls = self._m_bucket_reqs = None
-            self._m_bucket_batch = None
+            self._m_bucket_batch = self._m_quant_fallback = None
 
     # -------------------------- construction -------------------------- #
 
@@ -741,6 +871,10 @@ class ModelBank:
                     entry.target_offset,
                     entry.compute_dtype,
                     sorted(entry.factory_kwargs.items()),
+                    # storage dtype is part of the bucket identity: an
+                    # fp32 and a bf16 stack are different HBM layouts
+                    # compiled into different programs
+                    bank.bank_dtype,
                 ],
                 default=str,
             )
@@ -755,6 +889,8 @@ class ModelBank:
                     lookback=entry.lookback,
                     target_offset=entry.target_offset,
                     mesh=bank.mesh,
+                    bank_dtype=bank.bank_dtype,
+                    kernel_mode=bank.kernel_mode,
                 )
             bank._index[name] = (key, len(bucket.names))
             bucket.add(entry)
@@ -772,6 +908,13 @@ class ModelBank:
             bucket = bank._buckets[key]
             try:
                 bucket.finalize()
+                if bucket.quantize_error is not None:
+                    # the bucket SERVES (fp32 storage), but the capacity
+                    # win was lost for its members — counted and surfaced
+                    # so an operator sees a quarter-full chip coming
+                    bank.quantize_fallbacks[bucket.label] = bucket.quantize_error
+                    if bank._m_quant_fallback is not None:
+                        bank._m_quant_fallback.labels(bucket.label).inc()
             except Exception as exc:
                 logger.error(
                     "Bucket %s finalize FAILED (%d member(s) fall back to "
@@ -820,6 +963,41 @@ class ModelBank:
             # single-device bank) — lets an operator confirm an 8-chip
             # server is actually using its slice from /models alone
             "devices": int(self.mesh.devices.size) if self.mesh is not None else 1,
+            "bank_dtype": self.bank_dtype,
+            "kernel": self.kernel_mode,
+        }
+
+    def capacity_stats(self) -> Dict[str, Any]:
+        """Operator-facing HBM capacity summary (served in ``/stats`` as
+        ``bank_capacity``; bench and the north-star check record it so
+        the models-per-GB trajectory is auditable).
+
+        ``weight_bytes`` is the stacked params' storage footprint at the
+        effective dtype mix; ``fp32_bytes`` the same stack at fp32 —
+        their ratio is the capacity win low-precision storage bought.
+        Buckets whose quantization fell back to fp32 appear in
+        ``quantize_fallbacks`` and drag the ratio toward 1."""
+        total = sum(b.weight_bytes for b in self._buckets.values())
+        fp32 = sum(b.weight_bytes_fp32 for b in self._buckets.values())
+        by_dtype: Dict[str, int] = {}
+        for b in self._buckets.values():
+            d = b.effective_dtype
+            by_dtype[d] = by_dtype.get(d, 0) + b.weight_bytes
+        members = len(self._index)
+        bpm = total / members if members else None
+        return {
+            "dtype": self.bank_dtype,
+            "kernel": self.kernel_mode,
+            "members": members,
+            "weight_bytes": total,
+            "weight_bytes_by_dtype": by_dtype,
+            "fp32_bytes": fp32,
+            "capacity_ratio": round(fp32 / total, 3) if total else None,
+            "bytes_per_member": round(bpm, 1) if bpm is not None else None,
+            "models_per_gb": (
+                round(1024**3 / bpm, 1) if bpm else None
+            ),
+            "quantize_fallbacks": dict(self.quantize_fallbacks),
         }
 
     def pipeline_stats(self) -> Dict[str, Any]:

@@ -435,6 +435,12 @@ async def server_stats(request: web.Request) -> web.Response:
             # window, padded-buffer arena hit rate, and the measured
             # host/device overlap ratio across multi-group calls
             body["bank_pipeline"] = pipeline()
+        capacity = getattr(bank, "capacity_stats", None)
+        if capacity is not None:
+            # the HBM capacity picture: storage dtype, weight bytes per
+            # member, models-per-GB, and any buckets whose quantization
+            # fell back to fp32 (docs/observability.md contract)
+            body["bank_capacity"] = capacity()
     quarantine = request.app.get("quarantine")
     if quarantine is not None:
         # the degraded-mode surface: which models the breaker evicted
@@ -548,10 +554,13 @@ async def reload_models(request: web.Request) -> web.Response:
                     # same registry across reloads: the family children
                     # persist, so routed/padded counters stay monotonic
                     registry=app.get("metrics"),
-                    # same pipeline window/arena budget the app booted
-                    # with — a reload must not silently reset tuning
+                    # same pipeline window/arena budget and storage
+                    # precision the app booted with — a reload must not
+                    # silently reset tuning
                     inflight=cfg.get("inflight"),
                     arena_max_mb=cfg.get("arena_max_mb"),
+                    bank_dtype=cfg.get("bank_dtype"),
+                    bank_kernel=cfg.get("bank_kernel"),
                 ),
             )
             # the rebuilt bank's jit closures are cold: re-warm them here,

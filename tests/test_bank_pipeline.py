@@ -205,6 +205,32 @@ def test_arena_oversized_buffer_never_evicts_the_pool():
     assert again is small and not clean
 
 
+def test_arena_keys_on_dtype_never_aliases_shapes():
+    """Shape-keyed reuse must key on dtype too: a bf16 and an fp32
+    buffer of the SAME shape are different byte widths — handing one
+    out for the other would reinterpret memory (ISSUE 6 regression
+    guard for the low-precision bank era)."""
+    import ml_dtypes
+
+    shape = (4, 64, 10)
+    arena = PaddedArena(max_bytes=64 * 1024 * 1024)
+    f32, clean_f32 = arena.acquire(shape, np.float32)
+    bf16, clean_bf16 = arena.acquire(shape, ml_dtypes.bfloat16)
+    assert clean_f32 and clean_bf16
+    assert f32 is not bf16
+    assert f32.dtype == np.float32 and bf16.dtype == ml_dtypes.bfloat16
+    assert f32.nbytes == 2 * bf16.nbytes
+    arena.release(f32)
+    arena.release(bf16)
+    # each dtype's pool hands back its OWN buffer, never the other's
+    f32_again, clean = arena.acquire(shape, np.float32)
+    assert f32_again is f32 and not clean
+    bf16_again, clean = arena.acquire(shape, ml_dtypes.bfloat16)
+    assert bf16_again is bf16 and not clean
+    assert arena.hits == 2 and arena.misses == 2
+    assert arena.outstanding == 2
+
+
 def test_arena_disabled_is_plain_zeros(monkeypatch):
     arena = PaddedArena(max_bytes=0)
     buf, clean = arena.acquire((2, 8, 3))

@@ -31,6 +31,7 @@ pytestmark = pytest.mark.chaos
 # here (and get a test) or this list fails the suite
 EXPECTED_SITES = {
     "bank.finalize",
+    "bank.quantize",  # driven in tests/test_bank_quantized.py (chaos mark)
     "bank.score",
     "checkpoint.read",
     "checkpoint.write",

@@ -149,6 +149,14 @@ def run_check(
         models_per_sec=round(len(models) / max(1e-9, bank_elapsed), 1),
     )
     assert cov["banked"] == args.members, cov
+    # HBM capacity evidence (ISSUE 6): storage dtype, bytes per member,
+    # models-per-GB at the configured GORDO_BANK_DTYPE — with no bucket
+    # silently degraded to fp32 (a quantize fallback here would mean the
+    # capacity headline is not what the knob claims)
+    out["capacity"] = bank.capacity_stats()
+    assert out["capacity"]["weight_bytes"] > 0, out["capacity"]
+    assert out["capacity"]["models_per_gb"] > 0, out["capacity"]
+    assert not out["capacity"]["quantize_fallbacks"], out["capacity"]
 
     # ---- 5. warmup (per-bucket XLA compile, off the request path) ----
     t0 = time.time()
@@ -304,6 +312,13 @@ def run_check(
     assert 1.0 <= skew < float("inf"), skew
     bucket_calls = series("gordo_bank_bucket_calls_total", "bucket")
     assert bucket_calls and all(v >= 1 for v in bucket_calls.values()), bucket_calls
+    # capacity series (ISSUE 6 contract): per-dtype HBM weight bytes must
+    # render and agree with the bank's own accounting
+    weight_series = series("gordo_bank_weight_bytes", "dtype")
+    assert weight_series, "gordo_bank_weight_bytes missing from the registry"
+    assert sum(weight_series.values()) == out["capacity"]["weight_bytes"], (
+        weight_series, out["capacity"]["weight_bytes"],
+    )
     # fleet-train side (process default registry): program-build counts
     # recorded by FleetTrainer during phase 2 — present and bounded (a
     # recompile storm at 10k members would show up as builds >> buckets)
