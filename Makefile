@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline hotloop perf-guard trace-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo hotloop perf-guard trace-demo slo-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -30,6 +30,14 @@ chaos:
 chaos-deadline:
 	$(PYTHON) -m pytest tests/test_deadline.py -q -m chaos
 
+# SLO lane: goodput accounting + burn-rate engine — the chaos
+# acceptance (goodput drops / burn rises under latency faults with
+# tight deadlines), the no-drift contract between /slo, /stats, and the
+# registry, and the ledger's <=5% enabled / ~0% disabled overhead guard
+# (tests/test_goodput.py)
+slo:
+	$(PYTHON) -m pytest tests/ -q -m slo --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -50,6 +58,11 @@ perf-guard:
 # traces with their per-stage breakdown (tools/trace_demo.py)
 trace-demo:
 	$(PYTHON) tools/trace_demo.py
+
+# short mixed-deadline serve loop; prints the goodput ledger and the
+# SLO burn-rate table (tools/slo_demo.py)
+slo-demo:
+	$(PYTHON) tools/slo_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -590,11 +590,19 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
     # along for the dispatch-wait split.
     import asyncio
 
+    from gordo_components_tpu.observability.goodput import GoodputLedger
+    from gordo_components_tpu.observability.slo import SLOTracker
     from gordo_components_tpu.server.bank import BatchingEngine
 
     concurrency = min(n_models, 32)
 
-    async def _drive(n_iters):
+    # goodput accounting over the measured round (ISSUE 7): the perf
+    # trajectory should carry efficiency (goodput ratio, device busy
+    # share, burn rate) next to throughput, not just samples/sec
+    ledger = GoodputLedger()
+    tracker = SLOTracker(ledger, sample_interval_s=0.005, registry=None)
+
+    async def _drive(n_iters, record=False):
         # registry=False: the warm and measured rounds each build a fresh
         # engine, and shared registry histograms would blend them — the
         # reported queue-wait snapshot must cover the measured round only
@@ -608,8 +616,11 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
             name, Xr, _ = requests[i % n_models]
             for _ in range(n_iters):
                 t0 = time.monotonic()
-                await engine.score(name, Xr)
-                lat.append(time.monotonic() - t0)
+                r = await engine.score(name, Xr)
+                dt = time.monotonic() - t0
+                lat.append(dt)
+                if record:
+                    ledger.finish_request(200, dt, r.device_s)
 
         await asyncio.gather(*(client(i) for i in range(concurrency)))
         await engine.stop()
@@ -621,9 +632,18 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
         # compiles must not masquerade as tail latency (the bank's jit
         # cache persists across engines, so one throwaway round suffices)
         await _drive(1)
-        return await _drive(iters)
+        # attach the ledger AFTER the warm round: its compile-heavy
+        # device windows must not inflate the steady-state busy ratio
+        bank.ledger = ledger
+        ledger.started = time.monotonic()
+        tracker.sample(force=True)
+        return await _drive(iters, record=True)
 
     lat, engine = asyncio.run(_measure())
+    bank.ledger = None
+    tracker.sample(force=True)
+    slo_snap = tracker.snapshot()
+    goodput = ledger.snapshot()
     lat.sort()
     pct = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3
 
@@ -682,6 +702,14 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
         "bank_arena_hit_rate": arena_hit_rate,
         "bank_inflight_window": pipeline["inflight_window"],
         "bank_pipeline": pipeline,
+        # efficiency next to throughput (ISSUE 7): goodput over the
+        # measured engine round, device-busy share of its wall, and the
+        # worst SLO burn rate (0.0 on a clean run — nonzero means the
+        # bench itself missed objectives, which IS perf signal)
+        "goodput_ratio": goodput["goodput_ratio"],
+        "device_busy_ratio": goodput["device"]["busy_ratio"],
+        "slo_worst_burn_rate": (slo_snap["worst"] or {}).get("burn_rate"),
+        "goodput": goodput,
     }
 
 

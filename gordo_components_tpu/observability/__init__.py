@@ -8,12 +8,20 @@ endpoint and the ``/metrics`` scrape endpoint read the same underlying
 integers and can never drift.
 """
 
+from gordo_components_tpu.observability.goodput import (
+    GoodputLedger,
+    attribute_trace,
+)
 from gordo_components_tpu.observability.metrics import (
     Histogram,
     MetricsRegistry,
     get_registry,
     parse_prometheus_text,
     render_samples,
+)
+from gordo_components_tpu.observability.slo import (
+    SLOTracker,
+    merge_slo_snapshots,
 )
 from gordo_components_tpu.observability.tracing import (
     Span,
@@ -28,16 +36,20 @@ from gordo_components_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "GoodputLedger",
     "Histogram",
     "MetricsRegistry",
+    "SLOTracker",
     "Span",
     "Trace",
     "Tracer",
+    "attribute_trace",
     "chrome_trace",
     "current_trace",
     "format_traceparent",
     "get_registry",
     "get_tracer",
+    "merge_slo_snapshots",
     "parse_prometheus_text",
     "parse_traceparent",
     "render_samples",

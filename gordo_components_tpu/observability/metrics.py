@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "Histogram",
+    "LATENCY_BINS_PER_DECADE",
     "MetricsRegistry",
     "get_registry",
     "parse_prometheus_text",
@@ -49,6 +50,14 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 _DEF_LO = 5e-5
 _DEF_HI = 100.0
 _DEF_BPD = 10
+
+# latency-histogram resolution, ONE knob shared by
+# server.stats.LatencyHistogram and the goodput ledger's SLO histogram:
+# 32 bins/decade bounds percentile error at 10^(1/32)-1 ~ 7.5%, which is
+# what the documented <=10% low-ms contract (docs/observability.md
+# "Latency histogram resolution") rests on — tune it here or the serving
+# histograms and the SLO good-event counts silently diverge
+LATENCY_BINS_PER_DECADE = 32
 
 
 class Histogram:
@@ -82,17 +91,20 @@ class Histogram:
         self.sum = 0.0
         self.max = 0.0
 
+    def _idx(self, value: float) -> int:
+        """Bin index for ``value`` — the ONE copy of the log-bin math
+        ``record``/``bucket_le``/``count_le`` must all agree on."""
+        if value <= self._lo:
+            return 0
+        return min(
+            self._n_bins,
+            1 + int((math.log10(value) - self._log_lo) * self._bpd),
+        )
+
     def record(self, value: float) -> None:
         if value < 0:  # clock weirdness must not corrupt the histogram
             value = 0.0
-        if value <= self._lo:
-            idx = 0
-        else:
-            idx = min(
-                self._n_bins,
-                1 + int((math.log10(value) - self._log_lo) * self._bpd),
-            )
-        self.counts[idx] += 1
+        self.counts[self._idx(value)] += 1
         self.count += 1
         self.sum += value
         if value > self.max:
@@ -108,11 +120,17 @@ class Histogram:
         the ``le`` edges :meth:`buckets` exposes."""
         if value <= self._lo:
             return self._lo
-        idx = min(
-            self._n_bins,
-            1 + int((math.log10(value) - self._log_lo) * self._bpd),
-        )
+        idx = self._idx(value)
         return math.inf if idx >= self._n_bins else self._edge(idx)
+
+    def count_le(self, value: float) -> int:
+        """Observations recorded at or below the bucket containing
+        ``value`` (cumulative, bucket-resolution granular — the "good
+        event" count an SLO latency objective reads). Counting the whole
+        containing bucket matches the exposition's ``le`` semantics: the
+        answer is exact at bucket edges, otherwise an over-count bounded
+        by one bin width."""
+        return sum(self.counts[: self._idx(value) + 1])
 
     def percentile(self, q: float) -> float:
         """Upper edge of the bin containing the q-quantile observation

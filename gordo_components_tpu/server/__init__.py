@@ -11,12 +11,15 @@ import asyncio
 import itertools
 import logging
 import os
+import sys
 import time
 from typing import Optional
 
 from aiohttp import web
 
 from gordo_components_tpu.observability import MetricsRegistry, Tracer
+from gordo_components_tpu.observability.goodput import GoodputLedger
+from gordo_components_tpu.observability.slo import SLOTracker
 from gordo_components_tpu.observability.tracing import format_traceparent
 from gordo_components_tpu.resilience import QuarantineSet, configure_from_env
 from gordo_components_tpu.resilience.deadline import (
@@ -140,6 +143,26 @@ async def _stats_middleware(request, handler):
         # exactly what a tail-latency histogram exists to surface
         elapsed = time.monotonic() - t0
         hist.record(elapsed)
+        # goodput classification (observability/goodput.py): every
+        # SCORING request commits its wall time + attributed device time
+        # to the ledger with its final outcome — 504s are expired work,
+        # other >=400s (and non-finite scores behind a 200) wasted work.
+        # One dict read when disabled (GORDO_SLO=0 -> no ledger at all).
+        # A cancellation (client disconnect, or a hedge win cancelling
+        # the losing replica's request — PR 4's NORMAL operation) is not
+        # a server failure: it must not classify as a 500 and burn the
+        # availability budget, so it skips the ledger entirely.
+        if kind in ("prediction", "anomaly") and not isinstance(
+            sys.exc_info()[1], asyncio.CancelledError
+        ):
+            ledger = request.app.get("goodput")
+            if ledger is not None:
+                ledger.finish_request(
+                    status=status,
+                    elapsed_s=elapsed,
+                    device_s=request.get("device_s", 0.0),
+                    scores_finite=request.get("scores_finite", True),
+                )
         if trace is not None:
             trace.finish(error=status >= 400, status=status)
             # exemplar-style link on the latency histogram: the LAST trace
@@ -372,6 +395,17 @@ def build_app(
     app["metrics"] = registry
     registry.collector(_server_collector(app), key="server")
     registry.collector(_hbm_collector(), key="hbm")
+    # goodput ledger + SLO burn-rate tracker (observability/goodput.py,
+    # observability/slo.py): the middleware classifies every scoring
+    # request's outcome, the engine/bank feed stage + device-window
+    # seconds, and GET .../slo serves the multi-window burn rates the
+    # same registry renders as gordo_slo_burn_rate{objective,window}.
+    # GORDO_SLO=0 disables the whole plane (no ledger object exists; the
+    # call sites pay one None check — the hot-loop guard's contract)
+    ledger = GoodputLedger.from_env(registry)
+    app["goodput"] = ledger
+    if ledger is not None:
+        app["slo"] = SLOTracker(ledger, registry=registry)
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
     # per-model scoring-failure breaker (resilience/quarantine.py): a
@@ -419,6 +453,7 @@ def build_app(
             arena_max_mb=arena_max_mb,
             bank_dtype=bank_dtype,
             bank_kernel=bank_kernel,
+            ledger=ledger,
         )
         # expose the bank even when nothing banked: /models reports the
         # coverage (banked vs per-model fallback, with reasons)
@@ -450,6 +485,35 @@ def build_app(
                     )
 
             app.on_startup.append(_start_engine)
+
+    if ledger is not None:
+        # background SLO sampling cadence: the tracker also samples
+        # lazily on reads, but a replica nobody is scraping must still
+        # age its windows so the first scrape after an incident sees the
+        # burn, not a flat line ending at the last visitor
+        async def _start_slo_sampler(app: web.Application) -> None:
+            tracker = app["slo"]
+            tracker.sample(force=True)  # boot baseline sample
+
+            async def _tick():
+                while True:
+                    await asyncio.sleep(tracker.sample_interval_s)
+                    tracker.sample()
+
+            app["slo_sampler"] = asyncio.get_running_loop().create_task(_tick())
+
+        app.on_startup.append(_start_slo_sampler)
+
+        async def _stop_slo_sampler(app: web.Application) -> None:
+            import contextlib
+
+            task = app.get("slo_sampler")
+            if task is not None:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+
+        app.on_cleanup.append(_stop_slo_sampler)
 
     async def _stop_engine(app: web.Application) -> None:
         engine = app.get("bank_engine")

@@ -487,6 +487,11 @@ class ScoreResult:
     total_unscaled: np.ndarray
     total_scaled: np.ndarray
     offset: int = 0
+    # this request's share of its group's useful device window (seconds),
+    # assigned when a goodput ledger is attached (observability/goodput.py)
+    # — the HTTP layer commits it to the goodput/wasted cells once the
+    # request's final outcome is known; 0.0 when accounting is off
+    device_s: float = 0.0
 
     def to_frame(self, index=None):
         n_out = len(self.model_output)
@@ -538,13 +543,20 @@ class _GroupRun:
         "bucket", "req_ids", "req_plans", "slots", "n_chunks",
         "Xb", "Yb", "idx", "score_fn", "out", "off", "group_traces",
         "t_group", "t_chunks", "t_pad", "t_dispatch", "t_ready",
-        "t_device_done", "profile_dir", "_bufs",
+        "t_device_done", "t_post", "profile_dir", "_bufs",
+        "routed_rows", "total_rows", "shard_rows",
     )
 
     def __init__(self):
         self.out = None
         self.t_dispatch = 0.0
         self.t_ready = 0.0
+        self.t_post = 0.0
+        # goodput accounting feed (observability/goodput.py): real vs
+        # dispatched rows for the padded-waste split, per shard
+        self.routed_rows = 0
+        self.total_rows = 0
+        self.shard_rows: Tuple[Tuple[str, int, int], ...] = ()
         # earliest time the outputs were OBSERVED ready (polled at host
         # stage boundaries); 0.0 until then — the fence time is only an
         # upper bound that absorbs whatever host work ran in between
@@ -594,9 +606,17 @@ class ModelBank:
         arena_max_mb: Optional[float] = None,
         bank_dtype: Optional[str] = None,
         bank_kernel: Optional[str] = None,
+        ledger=None,
     ):
         self.max_rows = int(max_rows_per_call)
         self.mesh = mesh
+        # goodput ledger (observability/goodput.py): when attached, each
+        # bucket group's device window, padded-row split, and host stage
+        # seconds are accounted, and every ScoreResult carries its share
+        # of the useful device window (device_s). None = accounting off,
+        # one attribute check on the scoring path (the GORDO_SLO=0
+        # contract, held by the tests/test_goodput.py hot-loop guard)
+        self.ledger = ledger
         # low-precision weight bank (ops/quantize.py): storage dtype for
         # the stacked bucket params (env GORDO_BANK_DTYPE, default
         # float32 — the bitwise-parity baseline; bf16 halves and int8
@@ -1210,9 +1230,11 @@ class ModelBank:
         def finish(run: _GroupRun) -> None:
             nonlocal device_busy, last_ready
             poll_inflight()
+            ok = True
             try:
                 self._postprocess(run, requests, results, traces)
             except Exception as exc:
+                ok = False
                 if not return_exceptions:
                     raise
                 for ri in run.req_ids:
@@ -1223,8 +1245,11 @@ class ModelBank:
             # the completion). Windows never overlap: queue wait behind
             # the previous group's execution must not be counted twice.
             t_done = run.t_device_done or run.t_ready
-            device_busy += max(0.0, t_done - max(run.t_dispatch, last_ready))
+            window = max(0.0, t_done - max(run.t_dispatch, last_ready))
+            device_busy += window
             last_ready = max(last_ready, t_done)
+            if self.ledger is not None:
+                self._account_group(run, results, window, ok)
 
         try:
             for gi, (key, req_ids) in enumerate(groups):
@@ -1328,7 +1353,10 @@ class ModelBank:
                 t for t in (traces[ri] for ri in req_ids) if t is not None
             ] or None
         run.group_traces = group_traces
-        run.t_group = time.monotonic() if group_traces else 0.0
+        # stage timestamps serve BOTH tracing and goodput accounting;
+        # with neither attached they stay 0.0 and cost nothing
+        timed = group_traces is not None or self.ledger is not None
+        run.t_group = time.monotonic() if timed else 0.0
         F = bucket.n_features
         off = bucket.offset
         run.off = off
@@ -1382,7 +1410,7 @@ class ModelBank:
             req_plans.append((ri, X, cis, valids, X.shape[0] - off))
         run.req_plans = req_plans
         run.n_chunks = len(chunks)
-        run.t_chunks = time.monotonic() if group_traces else 0.0
+        run.t_chunks = time.monotonic() if timed else 0.0
         if self._m_shard_rows is not None:
             # per-bucket coalescing visibility: dispatches, request
             # fan-in, and the coalesced batch-size distribution
@@ -1423,6 +1451,9 @@ class ModelBank:
                     self._m_shard_rows.labels("0").inc(routed0)
                     self._m_shard_pad.labels("0").inc(B * T - routed0)
                     self._m_shard_reqs.labels("0").inc(len(chunks))
+                run.routed_rows = routed0
+                run.total_rows = B * T
+                run.shard_rows = (("0", routed0, B * T - routed0),)
                 run.score_fn = bucket.score_batch
             else:
                 # route each chunk to the shard owning its model: the
@@ -1439,6 +1470,7 @@ class ModelBank:
                 run._bufs = (Xb, Yb)
                 idx = np.zeros((D, Bl), np.int32)
                 slots = [None] * len(chunks)
+                shard_rows: List[Tuple[str, int, int]] = []
                 for d, dev_cis in enumerate(per_dev):
                     routed_d = 0
                     for j, ci in enumerate(dev_cis):
@@ -1468,13 +1500,17 @@ class ModelBank:
                         self._m_shard_rows.labels(sl).inc(routed_d)
                         self._m_shard_pad.labels(sl).inc(Bl * T - routed_d)
                         self._m_shard_reqs.labels(sl).inc(len(dev_cis))
+                    shard_rows.append((str(d), routed_d, Bl * T - routed_d))
+                run.routed_rows = sum(r for _s, r, _p in shard_rows)
+                run.total_rows = D * Bl * T
+                run.shard_rows = tuple(shard_rows)
                 run.score_fn = bucket.score_batch_sharded
         except BaseException:
             run.release(self.arena)
             raise
         run.Xb, run.Yb, run.idx = Xb, Yb, idx
         run.slots = slots
-        run.t_pad = time.monotonic() if group_traces else 0.0
+        run.t_pad = time.monotonic() if timed else 0.0
         return run
 
     def _dispatch(self, run: _GroupRun) -> None:
@@ -1544,13 +1580,15 @@ class ModelBank:
                     total_scaled=vals[4],
                     offset=run.off,
                 )
+            if run.group_traces or self.ledger is not None:
+                run.t_post = time.monotonic()
             if run.group_traces:
                 # the stage boundaries are per coalesced GROUP: every
                 # traced request in it gets the same span timestamps —
                 # per-request attribution of the shared batch's cost,
                 # which is exactly what coalescing makes invisible in a
                 # plain latency histogram
-                t_done = time.monotonic()
+                t_done = run.t_post
                 blabel = run.bucket.label
                 for ri in run.req_ids:
                     tr = traces[ri]  # type: ignore[index]
@@ -1572,6 +1610,46 @@ class ModelBank:
                     tr.add_span("postprocess", run.t_ready, t_done)
         finally:
             run.release(self.arena)
+
+    def _account_group(
+        self, run: _GroupRun, results: List[Any], window_s: float, ok: bool
+    ) -> None:
+        """Goodput accounting for one finished group (executor thread;
+        observability/goodput.py). The group's device window splits by
+        real-vs-pad dispatched rows: the padded share is waste the
+        ledger books directly, the useful share is apportioned to the
+        group's requests by their row counts (``ScoreResult.device_s``)
+        so the HTTP layer can commit it as goodput or waste once each
+        request's outcome is known. A failed group's useful share is
+        wasted outright — the device computed answers nobody received."""
+        led = self.ledger
+        total = run.total_rows
+        pad_frac = (1.0 - run.routed_rows / total) if total else 0.0
+        padded_s = window_s * pad_frac
+        useful_s = window_s - padded_s
+        led.account_group(
+            bucket=run.bucket.label,
+            window_s=window_s,
+            useful_s=useful_s,
+            padded_s=padded_s,
+            ok=ok,
+            coalesce_s=(
+                max(0.0, run.t_chunks - run.t_group) if run.t_group else 0.0
+            ),
+            pad_s=max(0.0, run.t_pad - run.t_chunks) if run.t_chunks else 0.0,
+            postprocess_s=(
+                max(0.0, run.t_post - run.t_ready) if run.t_post else 0.0
+            ),
+            shard_rows=run.shard_rows,
+        )
+        if ok and useful_s > 0.0:
+            req_rows = sum(plan[1].shape[0] for plan in run.req_plans)
+            if req_rows:
+                per_row = useful_s / req_rows
+                for ri, X_conv, _cis, _valids, _n_out in run.req_plans:
+                    r = results[ri]
+                    if isinstance(r, ScoreResult):
+                        r.device_s = per_row * X_conv.shape[0]
 
 
 # --------------------------------------------------------------------- #
@@ -1854,6 +1932,9 @@ class BatchingEngine:
             self.stats["batches"] += 1
             self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], len(batch))
             dispatch = time.monotonic()
+            # goodput ledger, resolved through the bank so a /reload's
+            # replacement bank keeps feeding the same app-level ledger
+            led = getattr(self.bank, "ledger", None)
             # drop already-expired entries BEFORE device dispatch: their
             # clients stopped waiting, and under saturation executing
             # them anyway is exactly the goodput collapse the deadline
@@ -1863,6 +1944,8 @@ class BatchingEngine:
                 if p.deadline is not None and p.deadline.expired(dispatch):
                     self.stats["deadline_expired"] += 1
                     self.queue_wait.record(dispatch - p.enqueued)
+                    if led is not None:
+                        led.record_queue_wait(dispatch - p.enqueued)
                     if p.trace is not None:
                         p.trace.add_span(
                             "deadline_expired", p.enqueued, dispatch,
@@ -1889,6 +1972,8 @@ class BatchingEngine:
             batch_deadline: Optional[Deadline] = None
             for p in batch:
                 self.queue_wait.record(dispatch - p.enqueued)
+                if led is not None:
+                    led.record_queue_wait(dispatch - p.enqueued)
                 if p.deadline is not None and (
                     batch_deadline is None
                     or p.deadline.expires_at < batch_deadline.expires_at

@@ -19,7 +19,10 @@ are safe without locks. Snapshot readers (the /stats handler) run on the
 same loop.
 """
 
-from gordo_components_tpu.observability.metrics import Histogram
+from gordo_components_tpu.observability.metrics import (
+    LATENCY_BINS_PER_DECADE,
+    Histogram,
+)
 
 __all__ = ["LatencyHistogram"]
 
@@ -27,8 +30,28 @@ __all__ = ["LatencyHistogram"]
 class LatencyHistogram(Histogram):
     """Latency histogram over log-spaced bins with percentile reads.
 
-    50us .. ~100s at 10 bins/decade (everything slower lands in the
-    overflow bin, where the tracked exact max is the reported bound) —
-    the defaults the serving stack has always used."""
+    50us .. ~100s at 32 bins/decade (everything slower lands in the
+    overflow bin, where the tracked exact max is the reported bound).
+
+    Bin-count audit (ISSUE 7 satellite): the original 10 bins/decade
+    bounded percentile error at one bin width — up to ~26% relative —
+    which is fine for "is p99 40ms or 4s" but blurs exactly the 1–50 ms
+    range where PR 4's deadline budgets live (a 20 ms budget and a 25 ms
+    p99 landed in the same bin). 32 bins/decade bounds the error at
+    10^(1/32)−1 ≈ 7.5% across the whole range — low-ms included — for
+    ~3x the (still O(200)-int) memory; the regression test in
+    tests/test_stats.py holds the bound at ≤10%. The resolution knob is
+    ``observability.metrics.LATENCY_BINS_PER_DECADE``, shared with the
+    goodput ledger's SLO histogram so the two cannot diverge. The generic
+    :class:`Histogram` default stays at 10/decade: batch-size and
+    row-count histograms don't need ms-grade resolution."""
 
     __slots__ = ()
+
+    def __init__(
+        self,
+        lo: float = 5e-5,
+        hi: float = 100.0,
+        bins_per_decade: int = LATENCY_BINS_PER_DECADE,
+    ):
+        super().__init__(lo=lo, hi=hi, bins_per_decade=bins_per_decade)

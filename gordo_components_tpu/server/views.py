@@ -98,14 +98,31 @@ def _note_scoring_result(request: web.Request, target: str, X, values) -> None:
     output resets the failure streak; non-finite output (NaN/Inf anywhere
     in ``values``) counts as a failure — UNLESS the request's own input
     was non-finite, which is the client's data, not the model's fault.
-    The input scan only runs on the (rare) non-finite path."""
+    The input scan only runs on the (rare) non-finite path. The
+    finiteness verdict is also stashed for the goodput ledger: a 200
+    carrying NaN scores is wasted work, not goodput."""
     quarantine = request.app.get("quarantine")
-    if quarantine is None:
+    ledger = request.app.get("goodput")
+    if quarantine is None and ledger is None:
         return
     arr = np.asarray(values)
-    if np.all(np.isfinite(arr)):
+    finite = bool(np.all(np.isfinite(arr)))
+    input_finite = True
+    if not finite:
+        input_finite = bool(
+            np.all(np.isfinite(np.asarray(X.values, dtype="float64")))
+        )
+    if ledger is not None:
+        # same exemption the breaker applies: NaN-in-NaN-out is the
+        # client's data — the server did its work, so it is not wasted
+        # and must not burn the availability budget. Only finite input
+        # producing non-finite output counts against goodput.
+        request["scores_finite"] = finite or not input_finite
+    if quarantine is None:
+        return
+    if finite:
         quarantine.record_success(target)
-    elif np.all(np.isfinite(np.asarray(X.values, dtype="float64"))):
+    elif input_finite:
         quarantine.record_failure(target, "non-finite scores in model output")
 
 
@@ -385,6 +402,31 @@ async def traces_slow(request: web.Request) -> web.Response:
     )
 
 
+@routes.get("/gordo/v0/{project}/slo")
+async def slo_view(request: web.Request) -> web.Response:
+    """Rolling multi-window SLO state (observability/slo.py): per
+    configured objective (availability / p99 latency / goodput ratio),
+    the windowed good/total deltas, ratios, and burn rates over the
+    5m/1h/6h windows, plus the worst burn across all of them.
+
+    The body is the SAME cached snapshot the registry's
+    ``gordo_slo_burn_rate`` gauges render and ``/stats`` embeds (the
+    no-drift contract — byte-identical between samples). ``?refresh=1``
+    forces a fresh sample first (operator / test hook; the background
+    cadence is ``GORDO_SLO_SAMPLE_S``). Watchman's ``GET /slo`` merges
+    this body fleet-wide."""
+    tracker = request.app.get("slo")
+    if tracker is None:
+        return web.json_response({"enabled": False})
+    if request.query.get("refresh", "").lower() in ("1", "true", "yes"):
+        tracker.sample(force=True)
+    body = {"enabled": True, **tracker.snapshot()}
+    ledger = request.app.get("goodput")
+    if ledger is not None:
+        body["goodput"] = ledger.snapshot()
+    return web.json_response(body)
+
+
 @routes.get("/gordo/v0/{project}/stats")
 async def server_stats(request: web.Request) -> web.Response:
     """Serving-process observability (SURVEY.md §5 metrics): request
@@ -446,6 +488,16 @@ async def server_stats(request: web.Request) -> web.Response:
         # the degraded-mode surface: which models the breaker evicted
         # (and why), plus the pre-quarantine failure streaks in flight
         body["quarantine"] = quarantine.snapshot()
+    ledger = request.app.get("goodput")
+    if ledger is not None:
+        # the goodput ledger: wall/device time by class (goodput vs
+        # wasted vs padded), host-stage overhead, per-bucket/per-shard
+        # breakdowns — the same cells /metrics renders
+        body["goodput"] = ledger.snapshot()
+    tracker = request.app.get("slo")
+    if tracker is not None:
+        # the SLO state GET .../slo serves, embedded verbatim (no-drift)
+        body["slo"] = tracker.snapshot()
     collection = request.app.get("collection")
     if collection is not None:
         body["load_failures"] = {
@@ -561,6 +613,9 @@ async def reload_models(request: web.Request) -> web.Response:
                     arena_max_mb=cfg.get("arena_max_mb"),
                     bank_dtype=cfg.get("bank_dtype"),
                     bank_kernel=cfg.get("bank_kernel"),
+                    # same app-level goodput ledger: accounting (like the
+                    # metric counters) stays monotonic across reloads
+                    ledger=app.get("goodput"),
                 ),
             )
             # the rebuilt bank's jit closures are cold: re-warm them here,
@@ -666,6 +721,9 @@ async def prediction(request: web.Request) -> web.Response:
                 deadline=deadline,
             )
             output = result.model_output
+            # goodput: the request's share of its group's device window
+            # (bank-attributed), committed by the middleware on response
+            request["device_s"] = result.device_s
         else:
             if deadline is not None and deadline.expired():
                 # per-model path: the executor job can't be cancelled
@@ -677,11 +735,13 @@ async def prediction(request: web.Request) -> web.Response:
             output = await loop.run_in_executor(
                 None, model.predict, X.values.astype("float32")
             )
+            request["device_s"] = time.monotonic() - t0
             if trace is not None:
                 # per-model fallback path: no coalescing stages, but the
                 # device work still gets its named span
                 trace.add_span(
-                    "device_execute", t0, time.monotonic(), path="per-model"
+                    "device_execute", t0, t0 + request["device_s"],
+                    path="per-model",
                 )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
@@ -735,6 +795,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 trace=trace,
                 deadline=deadline,
             )
+            request["device_s"] = result.device_s
             t0 = time.monotonic()
             frame = result.to_frame(index=X.index)
             if trace is not None:
@@ -746,9 +807,11 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
             loop = asyncio.get_running_loop()
             t0 = time.monotonic()
             frame = await loop.run_in_executor(None, model.anomaly, X, y)
+            request["device_s"] = time.monotonic() - t0
             if trace is not None:
                 trace.add_span(
-                    "device_execute", t0, time.monotonic(), path="per-model"
+                    "device_execute", t0, t0 + request["device_s"],
+                    path="per-model",
                 )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)

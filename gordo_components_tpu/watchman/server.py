@@ -25,7 +25,11 @@ import aiohttp
 from aiohttp import web
 
 from gordo_components_tpu import __version__
-from gordo_components_tpu.observability import parse_prometheus_text, render_samples
+from gordo_components_tpu.observability import (
+    merge_slo_snapshots,
+    parse_prometheus_text,
+    render_samples,
+)
 from gordo_components_tpu.resilience.deadline import Deadline
 from gordo_components_tpu.resilience.faults import faultpoint
 
@@ -442,6 +446,47 @@ class WatchmanState:
         scrape targets (same replica set, sibling path)."""
         return [u + "/traces/slow" for u in self._replica_prefixes()]
 
+    async def fleet_slo(self, refresh: bool = False) -> Dict[str, Any]:
+        """Fleet SLO rollup: fetch every replica's ``GET /slo`` and merge
+        (observability/slo.py::merge_slo_snapshots) — good/total deltas
+        sum per (objective, window), fleet burn rates recompute from the
+        summed ratios, and ``worst_burn`` names the replica index burning
+        hottest. Best-effort like the trace view: a replica that fails to
+        answer is marked unscraped, never an error. ``refresh`` forwards
+        ``?refresh=1`` so every replica forces a fresh sample first."""
+        urls = [u + "/slo" for u in self._replica_prefixes()]
+        params = {"refresh": "1"} if refresh else None
+        timeout = aiohttp.ClientTimeout(total=30)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def fetch(url):
+                async def get():
+                    async with session.get(url, params=params) as resp:
+                        if resp.status != 200:
+                            return None
+                        return await resp.json()
+
+                try:
+                    return await Deadline(10.0).wait_for(get())
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.debug("slo scrape failed for %s: %s", url, exc)
+                    return None
+
+            bodies = list(await asyncio.gather(*(fetch(u) for u in urls)))
+        merged = merge_slo_snapshots(bodies)
+        merged["replicas"] = [
+            {
+                "replica": i,
+                "scraped": body is not None,
+                "slo_enabled": bool(body and body.get("enabled")),
+                "worst": (body or {}).get("worst"),
+            }
+            for i, body in enumerate(bodies)
+        ]
+        return merged
+
     def _replica_prefixes(self) -> List[str]:
         """Per-replica ``.../gordo/v0/<project>`` prefixes, derived from
         the metrics scrape targets (the authoritative replica set)."""
@@ -805,10 +850,21 @@ def build_watchman_app(
             await state.fleet_slow_traces(per_replica=per_replica)
         )
 
+    async def slo(request: web.Request) -> web.Response:
+        """Fleet SLO rollup: per-objective/window good+total sums across
+        replicas, recomputed fleet burn rates, and per-replica worst-burn
+        attribution — "who is burning the fleet's error budget" in one
+        fetch. ``?refresh=1`` forces a fresh sample on every replica."""
+        refresh = request.query.get("refresh", "").lower() in (
+            "1", "true", "yes",
+        )
+        return web.json_response(await state.fleet_slo(refresh=refresh))
+
     app.router.add_get("/", root)
     app.router.add_get("/healthcheck", healthcheck)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
+    app.router.add_get("/slo", slo)
     return app
 
 

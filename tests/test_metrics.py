@@ -85,6 +85,84 @@ def test_label_escaping_round_trips():
     assert got == {'a"b\\c\nd', "a\\nb", "end\\"}
 
 
+def test_parser_round_trips_quantized_bucket_label_grammar():
+    """ISSUE 7 satellite — the exposition parser vs the PR-6 label
+    grammar: bucket labels now carry ``:qbf16``/``:qint8`` storage-dtype
+    suffixes (plus ``:o<offset>`` and 6-hex content-hash tails), and all
+    of them must survive render -> parse -> fleet rollup -> re-parse
+    without mangling — colons inside label VALUES are data, not metric
+    -name syntax."""
+    labels = [
+        "AutoEncoder:feedforward_hourglass:f10:l1:qbf16",
+        "LSTMAutoEncoder:lstm_hourglass:f24:l16:o1:qint8",
+        "ConvAutoEncoder:conv_ae:f8:l32:qbf16:ab12cd",
+    ]
+    reg = MetricsRegistry()
+    fam = reg.counter("gordo_bank_bucket_calls_total", "calls", ("bucket",))
+    hfam = reg.histogram(
+        "gordo_bank_bucket_batch_size", "batch", ("bucket",), lo=1.0, hi=1e5
+    )
+    for i, label in enumerate(labels):
+        fam.labels(label).inc(i + 1)
+        hfam.labels(label).record(4.0)
+    text = reg.render()
+    types, samples = parse_prometheus_text(text)
+    got = {
+        l["bucket"]: v
+        for n, l, v in samples
+        if n == "gordo_bank_bucket_calls_total"
+    }
+    assert got == {label: i + 1 for i, label in enumerate(labels)}
+    # histogram children keep the label on every _bucket/_sum/_count row
+    hist_labels = {
+        l["bucket"] for n, l, _ in samples if n.startswith(
+            "gordo_bank_bucket_batch_size"
+        )
+    }
+    assert hist_labels == set(labels)
+
+    # ...and through the watchman rollup: two replicas' scrapes aggregate
+    # and re-render with the label values intact (and counters summed)
+    from gordo_components_tpu.watchman.server import (
+        aggregate_fleet_metrics,
+        render_fleet_metrics,
+    )
+
+    agg = aggregate_fleet_metrics([text, text])
+    rollup = render_fleet_metrics(agg)
+    rtypes, rsamples = parse_prometheus_text(rollup)
+    regot = {
+        l["bucket"]: v
+        for n, l, v in rsamples
+        if n == "gordo_bank_bucket_calls_total"
+    }
+    assert regot == {label: 2 * (i + 1) for i, label in enumerate(labels)}
+    assert rtypes["gordo_bank_bucket_batch_size"] == "histogram"
+    rehist = {
+        l["bucket"]
+        for n, l, _ in rsamples
+        if n.startswith("gordo_bank_bucket_batch_size")
+    }
+    assert rehist == set(labels)
+
+
+def test_histogram_count_le():
+    """count_le: the SLO latency objective's 'good event' read — exact at
+    bucket edges, over-counting by at most the containing bucket."""
+    h = Histogram(lo=1e-3, hi=10.0, bins_per_decade=10)
+    for v in (0.002, 0.005, 0.010, 0.050, 0.500, 5.0):
+        h.record(v)
+    assert h.count_le(1e9) == 6  # everything (overflow included)
+    assert h.count_le(0.05 * 1.0001) >= 4
+    assert h.count_le(0.0005) == 0  # below every recorded value's bucket
+    mid = h.count_le(0.011)
+    assert 3 <= mid <= 4  # bucket-resolution bound
+    # monotone in value
+    probes = [0.001, 0.004, 0.02, 0.1, 1.0, 20.0]
+    counts = [h.count_le(p) for p in probes]
+    assert counts == sorted(counts)
+
+
 def test_non_finite_values_render_without_crashing():
     """A dead set_function closure reads as NaN; the scrape must render
     it (and the JSON snapshot must stay strictly parseable), not 500."""

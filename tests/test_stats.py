@@ -81,6 +81,50 @@ def test_percentile_single_observation():
         assert ho.percentile(q) == 5e4
 
 
+def test_low_ms_percentile_error_within_10pct():
+    """ISSUE 7 satellite — bucket-edge audit for ms-scale deadline
+    traffic: PR 4's 504s cluster near small budgets (5–50 ms), where the
+    old 10-bins/decade edges bounded percentile error at ~26% — a 20 ms
+    budget and a 25 ms p99 were indistinguishable. LatencyHistogram now
+    runs 32 bins/decade (10^(1/32)−1 ≈ 7.5% per bin); this regression
+    test holds the observed error at ≤10% across the low-ms range, for
+    several traffic shapes."""
+    rng = random.Random(7)
+    shapes = {
+        # uniform ms-scale spread (the mixed-deadline serving mix)
+        "uniform_1_50ms": [rng.uniform(0.001, 0.050) for _ in range(4000)],
+        # tight cluster just under a 20ms budget (the 504 cliff)
+        "cluster_15_20ms": [rng.uniform(0.015, 0.020) for _ in range(4000)],
+        # log-spread across the whole low-ms decade
+        "log_1_10ms": [10 ** rng.uniform(-3, -2) for _ in range(4000)],
+    }
+    for name, values in shapes.items():
+        h = LatencyHistogram()
+        for v in values:
+            h.record(v)
+        values.sort()
+        for q in (0.5, 0.9, 0.95, 0.99):
+            exact = values[min(len(values) - 1, int(q * len(values)))]
+            approx = h.percentile(q)
+            err = abs(approx - exact) / exact
+            assert err <= 0.10, (name, q, exact, approx, err)
+
+
+def test_latency_histogram_finer_than_generic_default():
+    """The serving histogram's resolution upgrade must not leak into the
+    generic Histogram default (batch-size/row-count histograms keep the
+    cheaper 10/decade layout)."""
+    from gordo_components_tpu.observability.metrics import Histogram
+
+    assert LatencyHistogram()._bpd == 32
+    assert Histogram()._bpd == 10
+    # same exposition shape contract: buckets end at +Inf with the total
+    h = LatencyHistogram()
+    h.record(0.004)
+    edges = h.buckets()
+    assert edges[-1][0] == float("inf") and edges[-1][1] == 1
+
+
 def test_percentile_overflow_bin_edges():
     """Overflow-bin behavior: low quantiles whose rank lands in real bins
     must NOT jump to the overflow max; ranks landing in the overflow bin

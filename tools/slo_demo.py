@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""slo-demo: drive a short mixed-deadline load against a live server and
+print the goodput ledger + the SLO burn-rate table (``make slo-demo``).
+
+Trains two tiny models into a temp dir, serves them through the real
+``build_app`` stack (bank + batching engine + goodput ledger + SLO
+tracker), and drives two phases of load:
+
+1. a healthy phase (generous deadlines — everything lands as goodput);
+2. a degraded phase: an ``engine.queue`` latency fault is armed and half
+   the requests carry a tight ``X-Gordo-Deadline-Ms`` budget, so they
+   504 before device dispatch — wasted wall time the ledger books and
+   the availability/goodput burn rates pick up.
+
+Then prints what ``GET /slo`` and the ledger saw — the operator's
+"are we meeting our objectives, and how fast is the budget burning"
+workflow without a cluster (same spirit as ``trace_demo.py``).
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GORDO_SLO_SAMPLE_S", "60")  # phases force samples
+
+import numpy as np  # noqa: E402
+
+
+def build_artifacts(root: str) -> None:
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.models import (
+        AutoEncoder,
+        DiffBasedAnomalyDetector,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(200, 3).astype("float32")
+    for i, name in enumerate(("demo-a", "demo-b")):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(epochs=1, batch_size=64)
+        )
+        det.fit(X + 0.01 * i)
+        serializer.dump(det, os.path.join(root, name), metadata={"name": name})
+
+
+def print_ledger(goodput: dict) -> None:
+    dev = goodput["device"]
+    print("goodput ledger")
+    print("=" * 64)
+    print(f"  requests        {goodput['requests']}")
+    ratio = goodput["goodput_ratio"]
+    print(f"  goodput_ratio   {ratio if ratio is not None else 'n/a'}")
+    print(
+        f"  wall seconds    goodput={goodput['wall']['goodput_s']:.3f}  "
+        f"wasted={goodput['wall']['wasted_s']:.3f}"
+    )
+    print(
+        f"  device seconds  goodput={dev['goodput_s']:.3f}  "
+        f"wasted={dev['wasted_s']:.3f}  padded={dev['padded_s']:.3f}  "
+        f"(busy_ratio={dev['busy_ratio']:.3f})"
+    )
+    stages = "  ".join(f"{k}={v:.3f}" for k, v in goodput["stages_s"].items())
+    print(f"  stage seconds   {stages}")
+
+
+def print_burn_table(slo: dict) -> None:
+    windows = list(slo["windows"])
+    print()
+    print("SLO burn rates (1.0 = burning exactly at budget)")
+    print("=" * 64)
+    header = f"{'objective':<18}{'target':>8} " + "".join(
+        f"{w:>10}" for w in windows
+    )
+    print(header)
+    print("-" * len(header))
+    for obj in slo["objectives"]:
+        cells = "".join(
+            f"{obj['windows'][w]['burn_rate']:>10.2f}" for w in windows
+        )
+        flag = "  << FAST BURN" if obj.get("fast_burn") else ""
+        print(f"{obj['name']:<18}{obj['target']:>8} {cells}{flag}")
+    worst = slo.get("worst")
+    if worst:
+        print(
+            f"\nworst burn: {worst['objective']} @ {worst['window']} "
+            f"= {worst['burn_rate']}"
+        )
+
+
+async def main(requests: int = 24) -> int:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu import resilience
+    from gordo_components_tpu.server import build_app
+
+    root = tempfile.mkdtemp(prefix="gordo-slo-demo-")
+    print(f"training 2 demo models into {root} ...", flush=True)
+    build_artifacts(root)
+
+    client = TestClient(TestServer(build_app(root)))
+    await client.start_server()
+    try:
+        rng = np.random.RandomState(1)
+
+        async def score(name, deadline_ms=None):
+            headers = (
+                {"X-Gordo-Deadline-Ms": str(deadline_ms)} if deadline_ms else {}
+            )
+            resp = await client.post(
+                f"/gordo/v0/demo/{name}/anomaly/prediction",
+                json={"X": rng.rand(48, 3).tolist()},
+                headers=headers,
+            )
+            return resp.status
+
+        print(f"phase 1: healthy load ({requests} requests) ...", flush=True)
+        for i in range(requests):
+            status = await score(("demo-a", "demo-b")[i % 2])
+            assert status == 200, status
+        await client.get("/gordo/v0/demo/slo?refresh=1")
+
+        print(
+            "phase 2: engine.queue latency fault + tight deadlines ...",
+            flush=True,
+        )
+        resilience.arm("engine.queue", delay_s=0.05, exc=None)
+        statuses = {}
+        for i in range(requests):
+            # alternate: tight 10ms budgets (they 504 at admission) mixed
+            # with normal traffic that survives the latency fault
+            status = await score(
+                ("demo-a", "demo-b")[i % 2],
+                deadline_ms=10 if i % 2 == 0 else None,
+            )
+            statuses[status] = statuses.get(status, 0) + 1
+        resilience.reset()
+        print(f"  statuses: {statuses}")
+
+        body = await (await client.get("/gordo/v0/demo/slo?refresh=1")).json()
+        print()
+        print_ledger(body["goodput"])
+        print_burn_table(body)
+    finally:
+        await client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests", type=int, default=24)
+    args = parser.parse_args()
+    sys.exit(asyncio.run(main(requests=args.requests)))
