@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo hotloop perf-guard trace-demo slo-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance hotloop perf-guard trace-demo slo-demo rebalance-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -38,6 +38,16 @@ chaos-deadline:
 slo:
 	$(PYTHON) -m pytest tests/ -q -m slo --continue-on-collection-errors
 
+# rebalance lane: the placement control plane — deterministic LPT
+# planner, zero-downtime generation swap (incl. the bank.swap chaos
+# rollback), the hot-workload >=2x skew-cut acceptance with zero non-200s
+# under concurrent load, watchman rollup consistency across a generation
+# change, and the <=5% load-tracking hot-loop guard
+# (tests/test_placement.py + the reload no-5xx regression)
+rebalance:
+	$(PYTHON) -m pytest tests/ -q -m rebalance --continue-on-collection-errors
+	$(PYTHON) -m pytest tests/test_reload.py -q -k zero_non_200
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -63,6 +73,11 @@ trace-demo:
 # SLO burn-rate table (tools/slo_demo.py)
 slo-demo:
 	$(PYTHON) tools/slo_demo.py
+
+# deliberately skewed fleet on an 8-shard virtual mesh -> plan -> swap;
+# prints shard skew before/after and the flip pause (tools/rebalance_demo.py)
+rebalance-demo:
+	$(PYTHON) tools/rebalance_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -713,6 +713,57 @@ def bench_bank_serving(n_models=64, n_features=10, rows=256, iters=10):
     }
 
 
+def bench_rebalance(members=256, devices=8, hot_weight=8, request_rows=64):
+    """Placement control plane (ISSUE 8) — a deliberately skewed fleet
+    on an 8-shard virtual mesh: the LPT planner + zero-downtime swap
+    must cut the measured shard skew >=2x, with a sub-ms generation
+    flip. Runs in a subprocess: the virtual device count has to land in
+    XLA_FLAGS before jax initializes, and this process already
+    committed its backend."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "rebalance_demo.py",
+    )
+    out = subprocess.run(
+        [
+            sys.executable, tool, "--members", str(members),
+            "--devices", str(devices), "--hot-weight", str(hot_weight),
+            "--request-rows", str(request_rows), "--platform", "cpu",
+        ],
+        capture_output=True, text=True, timeout=STALL_SECONDS, env=env,
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"rebalance demo failed: {' | '.join(tail[-3:])}")
+    # the JSON document is the LAST block whose opening line is a bare
+    # "{" (indent=1 keeps nested braces off column 0) — jax/absl banners
+    # before it may themselves contain brace characters
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    assert doc["skew_reduction"] >= 2.0, doc
+    return {
+        "rebalance_members": doc["members"],
+        "rebalance_devices": doc["devices"],
+        "rebalance_shard_skew_before": doc["shard_skew_before"],
+        "rebalance_shard_skew_after": doc["shard_skew_after"],
+        "rebalance_skew_reduction": doc["skew_reduction"],
+        "rebalance_predicted_improvement": doc["plan"][
+            "predicted_improvement"
+        ],
+        "rebalance_moved_members": doc["plan"]["moved"],
+        "rebalance_swap_pause_ms": doc["swap_pause_ms"],
+        "rebalance_bank_rebuild_s": doc["rebuild_s"],
+        "rebalance": doc,
+    }
+
+
 def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
     """Config 5 extension — sequence models served from the HBM bank
     (windowing runs in-graph with the bucket's static lookback)."""
@@ -1228,6 +1279,7 @@ METRICS = (
     ("bank_serving", bench_bank_serving),
     ("bank_capacity", bench_bank_capacity),
     ("bank_sequence", bench_bank_sequence),
+    ("rebalance", bench_rebalance),
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
@@ -1253,6 +1305,7 @@ CPU_KWARGS = {
     "bank_serving": dict(n_models=16, iters=5),
     "bank_capacity": dict(n_models=3, rows=128, iters=4),
     "bank_sequence": dict(n_models=8, iters=5),
+    "rebalance": dict(members=64, request_rows=32),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
     # the full 10k leg takes ~2.5 min on one core (measured; most of it

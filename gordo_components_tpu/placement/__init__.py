@@ -1,0 +1,46 @@
+"""Placement control plane: load-aware shard rebalancing + hot swap.
+
+Closes the loop PR 1 opened: per-shard routed/padded-row counters made
+routing skew *visible* (``gordo_fleet_shard_skew_ratio``) and PR 7
+priced it (padded rows are goodput lost), but nothing *acted* on the
+signal — a hot model's bucket block pinned one shard while the others
+burned the same FLOPs on padding. This package acts:
+
+- :mod:`~gordo_components_tpu.placement.planner` — a deterministic
+  rebalance planner (greedy longest-processing-time under the bank's
+  equal-slots-per-shard HBM constraint) over the observed per-model
+  routed rows and the goodput ledger snapshot;
+- :mod:`~gordo_components_tpu.placement.swap` — the zero-downtime
+  double-buffered bank swap: build the new stacked/quantized state off
+  to the side, warm-compile it, flip the generation pointer, drop the
+  old buffers while in-flight batches drain on the old generation;
+- :mod:`~gordo_components_tpu.placement.controller` — the control loop
+  (``POST /rebalance`` / ``GET /placement`` and the in-server
+  ``GORDO_REBALANCE=auto`` evaluator) tying the two together.
+"""
+
+from gordo_components_tpu.placement.planner import (  # noqa: F401
+    RebalancePlan,
+    plan_rebalance,
+    skew_ratio,
+)
+from gordo_components_tpu.placement.swap import (  # noqa: F401
+    SwapResult,
+    build_bank,
+    snapshot_collectors,
+    swap_bank,
+)
+from gordo_components_tpu.placement.controller import (  # noqa: F401
+    PlacementController,
+)
+
+__all__ = [
+    "PlacementController",
+    "RebalancePlan",
+    "SwapResult",
+    "build_bank",
+    "plan_rebalance",
+    "skew_ratio",
+    "snapshot_collectors",
+    "swap_bank",
+]

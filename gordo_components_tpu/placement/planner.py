@@ -1,0 +1,281 @@
+"""Deterministic load-aware shard rebalance planner.
+
+The bank places each bucket's members in contiguous blocks of
+``shard_size`` along the stacked model axis (``server/bank.py``
+``_Bucket``): member i lives on shard ``i // shard_size``. That
+placement is fixed at build time by insertion order — so a hot model
+(or several that happen to sort adjacently) concentrates routed rows on
+one shard while the others dispatch the same ``Bl * T`` rows as padding.
+
+This module turns the observed per-model routed-row counters into a
+better stacking order:
+
+- **Constraint**: every shard holds exactly ``shard_size`` stack slots
+  (the equal-HBM-per-chip capacity constraint — the stacked pytree's
+  leading axis must split evenly over the mesh, so a plan can only
+  permute members between equal-sized blocks, never grow one).
+- **Objective**: minimize predicted skew = max/mean of per-shard routed
+  rows, the exact quantity ``gordo_fleet_shard_skew_ratio`` reports.
+- **Algorithm**: greedy longest-processing-time (LPT) per bucket —
+  members sorted by observed load descending (name tiebreak, so equal
+  inputs always produce the identical plan) are assigned one at a time
+  to the least-loaded shard that still has a free slot. LPT is the
+  textbook 4/3-approximation for makespan on identical machines; under
+  the slot cap it stays within one hot member of optimal, which is all
+  a serving rebalance needs.
+- **Hysteresis**: a plan only marks itself applicable when the
+  predicted improvement factor (skew_before / skew_after) clears a
+  configurable threshold — a no-op or marginal plan must never trigger
+  a bank rebuild (``GORDO_REBALANCE_THRESHOLD``, default 1.2).
+
+The planner is pure (no bank mutation, no clocks): bank placement in,
+:class:`RebalancePlan` out. The goodput ledger snapshot rides in as a
+second gate — when the fleet's padded-row waste ratio is already below
+``min_pad_ratio`` there is nothing worth rebuilding a bank over, no
+matter what the raw skew number says.
+"""
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+DEFAULT_IMPROVEMENT_THRESHOLD = 1.2
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+def default_threshold() -> float:
+    """Improvement factor a plan must predict before it applies
+    (``GORDO_REBALANCE_THRESHOLD``; docs/operations.md knob table)."""
+    return _env_float(
+        "GORDO_REBALANCE_THRESHOLD", DEFAULT_IMPROVEMENT_THRESHOLD
+    )
+
+
+def skew_ratio(loads: Sequence[float]) -> Optional[float]:
+    """max/mean over per-shard loads — the fleet skew definition
+    (``watchman/server.py::aggregate_fleet_metrics``). ``None`` when
+    there is no load at all (no signal is not "perfectly balanced")."""
+    vals = list(loads)
+    if not vals:
+        return None
+    mean = sum(vals) / len(vals)
+    if mean <= 0:
+        return None
+    return max(vals) / mean
+
+
+@dataclass
+class BucketPlan:
+    """One bucket's planned stacking order."""
+
+    bucket: str  # the bucket's metric label
+    key: str  # the bank's internal bucket key (identity across rebuilds)
+    n_shards: int
+    shard_size: int
+    order: List[str]  # new stack order; shard d = order[d*size:(d+1)*size]
+    moved: int  # members whose owning shard changed
+    skew_before: Optional[float]
+    skew_after: Optional[float]
+    shard_loads_before: List[float] = field(default_factory=list)
+    shard_loads_after: List[float] = field(default_factory=list)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "bucket": self.bucket,
+            "n_shards": self.n_shards,
+            "shard_size": self.shard_size,
+            "members": len(self.order),
+            "moved": self.moved,
+            "skew_before": _r(self.skew_before),
+            "skew_after": _r(self.skew_after),
+            "shard_loads_before": self.shard_loads_before,
+            "shard_loads_after": self.shard_loads_after,
+        }
+
+
+@dataclass
+class RebalancePlan:
+    """A full plan over every sharded bucket, plus the verdict."""
+
+    buckets: List[BucketPlan]
+    skew_before: Optional[float]  # combined per-shard loads, all buckets
+    skew_after: Optional[float]
+    improvement: Optional[float]
+    threshold: float
+    should_apply: bool
+    reason: str
+    observed_rows: int  # total routed rows feeding the plan
+    moved: int
+
+    def member_order(self) -> Dict[str, List[str]]:
+        """Per-bucket-key planned stack order, the shape
+        :func:`~gordo_components_tpu.placement.swap.build_bank` takes."""
+        return {b.key: list(b.order) for b in self.buckets}
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "should_apply": self.should_apply,
+            "reason": self.reason,
+            "skew_before": _r(self.skew_before),
+            "skew_after": _r(self.skew_after),
+            "improvement": _r(self.improvement),
+            "threshold": self.threshold,
+            "observed_rows": self.observed_rows,
+            "moved": self.moved,
+            "buckets": [b.summary() for b in self.buckets],
+        }
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(float(v), 4)
+
+
+def _plan_bucket(
+    bucket: Mapping[str, Any], loads: Mapping[str, float]
+) -> BucketPlan:
+    members: List[str] = list(bucket["members"])
+    n_shards = max(1, int(bucket["n_shards"]))
+    shard_size = int(bucket["shard_size"]) or len(members)
+    mload = {name: float(loads.get(name, 0.0)) for name in members}
+
+    before = [0.0] * n_shards
+    for i, name in enumerate(members):
+        before[min(i // shard_size, n_shards - 1)] += mload[name]
+
+    # LPT under the slot cap: hottest first into the least-loaded shard
+    # with a free slot; ties break on shard index, then member name —
+    # the same inputs must always emit the same plan (the determinism
+    # contract tests/test_placement.py pins)
+    ranked = sorted(members, key=lambda n: (-mload[n], n))
+    assigned: List[List[str]] = [[] for _ in range(n_shards)]
+    shard_load = [0.0] * n_shards
+    for name in ranked:
+        d = min(
+            (di for di in range(n_shards) if len(assigned[di]) < shard_size),
+            key=lambda di: (shard_load[di], di),
+        )
+        assigned[d].append(name)
+        shard_load[d] += mload[name]
+
+    old_shard = {
+        name: min(i // shard_size, n_shards - 1)
+        for i, name in enumerate(members)
+    }
+    moved = sum(
+        1
+        for d, block in enumerate(assigned)
+        for name in block
+        if old_shard[name] != d
+    )
+    order = [name for block in assigned for name in block]
+    return BucketPlan(
+        bucket=str(bucket.get("bucket", "?")),
+        key=str(bucket.get("key", bucket.get("bucket", "?"))),
+        n_shards=n_shards,
+        shard_size=shard_size,
+        order=order,
+        moved=moved,
+        skew_before=skew_ratio(before),
+        skew_after=skew_ratio(shard_load),
+        shard_loads_before=[round(v, 1) for v in before],
+        shard_loads_after=[round(v, 1) for v in shard_load],
+    )
+
+
+def plan_rebalance(
+    placement: Sequence[Mapping[str, Any]],
+    loads: Mapping[str, float],
+    threshold: Optional[float] = None,
+    min_rows: int = 0,
+    goodput: Optional[Mapping[str, Any]] = None,
+    min_pad_ratio: float = 0.0,
+) -> RebalancePlan:
+    """Plan a rebalance over a bank's current placement.
+
+    ``placement`` is ``ModelBank.placement()["buckets"]`` (per bucket:
+    members in stack order, ``n_shards``, ``shard_size``); ``loads``
+    maps member name -> observed routed rows over the decision window
+    (the controller feeds the delta since the last applied plan, so an
+    old hot streak cannot bury a new one). ``goodput`` (optional, a
+    ``GoodputLedger.snapshot()``) gates the plan on the fleet's
+    padded-row waste ratio: below ``min_pad_ratio`` the skew isn't
+    costing device time worth a rebuild. The plan is advisory —
+    ``should_apply`` encodes the verdict, the caller decides."""
+    if threshold is None:
+        threshold = default_threshold()
+    sharded = [b for b in placement if int(b.get("n_shards", 1)) > 1]
+    observed_rows = int(sum(loads.values())) if loads else 0
+    plans = [_plan_bucket(b, loads) for b in sharded]
+    moved = sum(p.moved for p in plans)
+
+    # combined per-shard loads across buckets: the per-shard routed-row
+    # counters (and the fleet skew gauge) sum over buckets, so the
+    # verdict must be computed on the same aggregate, not per bucket
+    n_shards = max((p.n_shards for p in plans), default=0)
+    combined_before = [0.0] * n_shards
+    combined_after = [0.0] * n_shards
+    for p in plans:
+        for d in range(p.n_shards):
+            combined_before[d] += p.shard_loads_before[d]
+            combined_after[d] += p.shard_loads_after[d]
+    skew_before = skew_ratio(combined_before)
+    skew_after = skew_ratio(combined_after)
+    improvement = (
+        skew_before / skew_after
+        if skew_before is not None and skew_after not in (None, 0.0)
+        else None
+    )
+
+    def plan(should_apply: bool, reason: str) -> RebalancePlan:
+        return RebalancePlan(
+            buckets=plans,
+            skew_before=skew_before,
+            skew_after=skew_after,
+            improvement=improvement,
+            threshold=float(threshold),
+            should_apply=should_apply,
+            reason=reason,
+            observed_rows=observed_rows,
+            moved=moved,
+        )
+
+    if not plans:
+        return plan(False, "no sharded buckets (single-shard bank)")
+    if observed_rows < min_rows:
+        return plan(
+            False,
+            f"insufficient load signal ({observed_rows} routed rows "
+            f"observed, need >= {min_rows})",
+        )
+    if goodput is not None and min_pad_ratio > 0.0:
+        pad = goodput.get("padded_row_waste_ratio")
+        if pad is not None and pad < min_pad_ratio:
+            return plan(
+                False,
+                f"padded-row waste ratio {pad:.4f} below floor "
+                f"{min_pad_ratio:.4f}: skew is not costing device time",
+            )
+    if moved == 0:
+        return plan(False, "placement already optimal (nothing to move)")
+    if improvement is None:
+        return plan(False, "no routed-row signal on any sharded bucket")
+    if improvement < threshold:
+        return plan(
+            False,
+            f"predicted improvement {improvement:.2f}x below threshold "
+            f"{threshold:.2f}x",
+        )
+    return plan(
+        True,
+        f"predicted skew {skew_before:.2f} -> {skew_after:.2f} "
+        f"({improvement:.2f}x improvement, {moved} member(s) move)",
+    )

@@ -463,6 +463,25 @@ def build_app(
         # booted with, even if the env changed underneath it since
         app["bank_config"]["bank_dtype"] = bank.bank_dtype
         app["bank_config"]["bank_kernel"] = bank.kernel_mode
+        # placement control plane (placement/): GET /placement and
+        # POST /rebalance work in every mode; GORDO_REBALANCE=auto adds
+        # the background evaluator. Generation 0 is the boot bank; every
+        # applied swap (rebalance or /reload) bumps it.
+        from gordo_components_tpu.placement.controller import (
+            PlacementController,
+        )
+
+        app["bank_generation"] = 0
+        app["placement"] = PlacementController(app)
+
+        async def _start_placement(app: web.Application) -> None:
+            app["placement"].start()
+
+        async def _stop_placement(app: web.Application) -> None:
+            await app["placement"].stop()
+
+        app.on_startup.append(_start_placement)
+        app.on_cleanup.append(_stop_placement)
         if len(bank):
 
             async def _start_engine(app: web.Application) -> None:

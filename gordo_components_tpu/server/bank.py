@@ -665,6 +665,15 @@ class ModelBank:
         self._buckets: Dict[str, _Bucket] = {}
         self._index: Dict[str, Tuple[str, int]] = {}  # name -> (bucket_key, i)
         self._tags: Dict[str, List[str]] = {}
+        # bank generation: bumped by the placement control plane's swap
+        # (placement/swap.py) every time a rebuilt bank replaces this one
+        # — exported as gordo_bank_generation, 0 for the boot bank
+        self.generation = 0
+        # per-model routed rows, the placement planner's load signal
+        # (placement/planner.py): one dict get+set per request against a
+        # multi-ms scoring dispatch. Set to None to disable entirely
+        # (the rebalance hot-loop overhead guard's control arm).
+        self.model_rows: Optional[Dict[str, int]] = {}
         # name -> human-readable reason the model serves per-model instead
         self.fallback: Dict[str, str] = {}
         # bucket label -> error for buckets whose finalize (stack/compile)
@@ -1040,6 +1049,32 @@ class ModelBank:
             },
         }
 
+    def placement(self) -> Dict[str, Any]:
+        """The live model->shard assignment (placement control plane's
+        input; served through ``GET /placement``): per bucket, the
+        members in stack order — member i of a bucket lives on shard
+        ``i // shard_size`` (contiguous blocks along the stacked model
+        axis, ``_Bucket.finalize``). Single-device banks report one
+        shard holding everything."""
+        buckets = []
+        for key, b in self._buckets.items():
+            buckets.append(
+                {
+                    "bucket": b.label,
+                    "key": key,
+                    "n_shards": int(b.n_shards),
+                    "shard_size": int(b.shard_size or len(b.names)),
+                    "members": list(b.names),
+                }
+            )
+        return {
+            "bank_generation": int(self.generation),
+            "devices": (
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            ),
+            "buckets": buckets,
+        }
+
     @staticmethod
     def _warmup_grid_env(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
         raw = os.environ.get(name)
@@ -1361,6 +1396,7 @@ class ModelBank:
         off = bucket.offset
         run.off = off
         rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
+        mrows = self.model_rows
         for ri, X in zip(req_ids, rows):
             if X.ndim != 2 or X.shape[1] != F:
                 raise ValueError(
@@ -1374,6 +1410,11 @@ class ModelBank:
                     f"Request for {requests[ri][0]!r}: need more than "
                     f"{off} rows (sequence warm-up), got {X.shape[0]}"
                 )
+            if mrows is not None:
+                # the planner's per-model load signal (rebalancing acts
+                # on rows, the unit the shard counters already speak)
+                name = requests[ri][0]
+                mrows[name] = mrows.get(name, 0) + X.shape[0]
         # rows-per-call stays a power of two and never exceeds max_rows
         # (but must always cover at least one window + one output row)
         T = min(

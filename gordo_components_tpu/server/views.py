@@ -593,61 +593,120 @@ async def reload_models(request: web.Request) -> web.Response:
             for name in changes["updated"] + changes["removed"]:
                 quarantine.drop(name)
         bank_models = None
+        swap_info = None
         if app.get("bank_enabled"):
-            from gordo_components_tpu.server.bank import BatchingEngine, ModelBank
-
-            cfg = app.get("bank_config", {})
-            bank = await loop.run_in_executor(
-                None,
-                functools.partial(
-                    ModelBank.from_models,
-                    collection.models,
-                    mesh=app.get("bank_mesh"),
-                    # same registry across reloads: the family children
-                    # persist, so routed/padded counters stay monotonic
-                    registry=app.get("metrics"),
-                    # same pipeline window/arena budget and storage
-                    # precision the app booted with — a reload must not
-                    # silently reset tuning
-                    inflight=cfg.get("inflight"),
-                    arena_max_mb=cfg.get("arena_max_mb"),
-                    bank_dtype=cfg.get("bank_dtype"),
-                    bank_kernel=cfg.get("bank_kernel"),
-                    # same app-level goodput ledger: accounting (like the
-                    # metric counters) stays monotonic across reloads
-                    ledger=app.get("goodput"),
-                ),
+            # the zero-downtime swap primitive (placement/swap.py): the
+            # replacement bank builds and warm-compiles off to the side
+            # (same mesh/registry/pipeline/precision config and goodput
+            # ledger the app booted with, so counters stay monotonic and
+            # tuning never silently resets), then one generation flip
+            # moves serving over — in-flight batches drain on the old
+            # bank, so a reload has no 5xx window
+            from gordo_components_tpu.placement.swap import (
+                build_bank,
+                snapshot_collectors,
+                swap_bank,
             )
-            # the rebuilt bank's jit closures are cold: re-warm them here,
-            # inside the reload (still behind the single-flight lock, off
-            # the scoring path) so the first request after a reload doesn't
-            # pay the XLA compile either
-            import os
 
-            if len(bank) and os.environ.get("GORDO_SERVER_WARMUP", "1") != "0":
-                await loop.run_in_executor(None, bank.warmup)
-            app["bank"] = bank
-            engine = app.get("bank_engine")
-            if engine is not None:
-                engine.bank = bank  # in-flight batches keep the old bank object
-            elif len(bank):
-                cfg = app.get("bank_config", {})
-                engine = BatchingEngine(
-                    bank,
-                    max_batch=cfg.get("max_batch", 64),
-                    flush_ms=cfg.get("flush_ms", 2.0),
-                    max_queue=cfg.get("max_queue"),
+            prev_collectors = snapshot_collectors(app.get("metrics"))
+            try:
+                bank = await loop.run_in_executor(
+                    None,
+                    functools.partial(build_bank, app, collection.models),
                 )
-                engine.start()
-                app["bank_engine"] = engine
-            bank_models = len(bank)
-    return web.json_response(
-        {
-            "changes": changes,
-            "models": collection.names(),
-            "bank_models": bank_models,
-        }
-    )
+            except Exception:
+                # a stillborn build must not leave the registry pointing
+                # at its dead collectors — the serving bank's series keep
+                # rendering (swap_bank handles the flip-failure case)
+                from gordo_components_tpu.placement.swap import (
+                    _restore_collectors,
+                )
+
+                _restore_collectors(app.get("metrics"), prev_collectors)
+                raise
+            result = swap_bank(app, bank, prev_collectors=prev_collectors)
+            bank_models = result.bank_models
+            swap_info = {
+                "generation": result.generation,
+                "pause_ms": round(result.pause_s * 1e3, 3),
+            }
+            controller = app.get("placement")
+            if controller is not None:
+                # a reload IS a swap: the controller's stats and pause
+                # histogram must agree with the generation it reports
+                controller.record_swap(result)
+    body = {
+        "changes": changes,
+        "models": collection.names(),
+        "bank_models": bank_models,
+    }
+    if swap_info is not None:
+        body["swap"] = swap_info
+    return web.json_response(body)
+
+
+@routes.get("/gordo/v0/{project}/placement")
+async def placement_view(request: web.Request) -> web.Response:
+    """The live model->shard placement (placement control plane): per
+    bucket, the members in stack order with their per-shard observed
+    window loads, the current bank generation, the controller's knobs
+    and counters, and — with ``?dry_run=1`` — a full plan preview
+    (what ``POST /rebalance`` would do right now, without doing it)."""
+    controller = request.app.get("placement")
+    if controller is None:
+        return web.json_response({"enabled": False})
+    dry_run = request.query.get("dry_run", "").lower() in ("1", "true", "yes")
+    return web.json_response(controller.placement_view(dry_run=dry_run))
+
+
+@routes.post("/gordo/v0/{project}/rebalance")
+async def rebalance(request: web.Request) -> web.Response:
+    """Evaluate the rebalance planner and apply the plan via the
+    zero-downtime swap. Body (optional JSON): ``{"force": true}``
+    applies a skew-reducing plan even below the improvement threshold
+    (operator override). ``?dry_run=1`` evaluates without applying.
+    A failed swap rolls back to the old generation (the old bank keeps
+    serving every request) and answers 500 with ``rolled_back``."""
+    controller = request.app.get("placement")
+    if controller is None:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": "placement control plane not enabled"}),
+            content_type="application/json",
+        )
+    force = False
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "expected a JSON body"}),
+                content_type="application/json",
+            )
+        if isinstance(body, dict):
+            force = bool(body.get("force", False))
+        elif body:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "expected a JSON object body"}),
+                content_type="application/json",
+            )
+    dry_run = request.query.get("dry_run", "").lower() in ("1", "true", "yes")
+    try:
+        result = await controller.rebalance(force=force, dry_run=dry_run)
+    except Exception as exc:
+        # swap_bank's rollback contract already ran: the old generation
+        # is serving, nothing was dropped — the 500 reports the failed
+        # ATTEMPT, not a degraded server
+        logger.exception("rebalance failed (rolled back)")
+        return web.json_response(
+            {
+                "error": f"{type(exc).__name__}: {exc}",
+                "rolled_back": True,
+                "generation": int(request.app.get("bank_generation", 0)),
+                "request_id": request.get("request_id"),
+            },
+            status=500,
+        )
+    return web.json_response(result)
 
 
 @routes.get("/gordo/v0/{project}/{target}/healthcheck")

@@ -91,5 +91,18 @@ def enable_compile_cache(cache_dir: str, min_compile_seconds: float = 1.0) -> st
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
     )
+    try:
+        # jax (>=0.4.30s) memoizes "is the cache used" at the FIRST
+        # compile of the process: any jit before this call would freeze
+        # the verdict at "no" and silently ignore the config above for
+        # the process lifetime. Reset the memo so the next compile
+        # re-evaluates — this makes enabling the cache mid-process (a
+        # /reload-created bank, the rebalance swap's rebuild, tests)
+        # actually take effect, not just enabling-before-first-compile.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # private API: degrade to the old behavior
+        logger.debug("compilation_cache.reset_cache unavailable", exc_info=True)
     logger.info("persistent XLA compilation cache at %s", cache_dir)
     return cache_dir

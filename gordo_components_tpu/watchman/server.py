@@ -487,6 +487,65 @@ class WatchmanState:
         ]
         return merged
 
+    async def fleet_rebalance(
+        self, dry_run: bool = False, force: bool = False
+    ) -> Dict[str, Any]:
+        """Fleet rebalance fan-out (placement control plane): POST every
+        replica's ``/rebalance`` (or preview with ``dry_run``) and
+        report per-replica verdicts — watchman as the fleet's placement
+        controller for deploys that run it instead of the in-server
+        ``GORDO_REBALANCE=auto`` loop. Best-effort per replica: one
+        replica's failed swap (it rolled back and keeps serving its old
+        generation) must not abort the others' rebalances."""
+        urls = [u + "/rebalance" for u in self._replica_prefixes()]
+        params = {"dry_run": "1"} if dry_run else None
+        payload = {"force": True} if force else {}
+        timeout = aiohttp.ClientTimeout(total=300)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def post(url):
+                async def go():
+                    async with session.post(
+                        url, params=params, json=payload
+                    ) as resp:
+                        return resp.status, await resp.json()
+
+                try:
+                    # generous bound: an applied swap pays a bank build +
+                    # warm compile before it answers (the flip itself is
+                    # sub-millisecond; see the swap-pause histogram)
+                    return await Deadline(240.0).wait_for(go())
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.warning("rebalance failed for %s: %s", url, exc)
+                    return None, {"error": f"{type(exc).__name__}: {exc}"}
+
+            results = list(await asyncio.gather(*(post(u) for u in urls)))
+        replicas = []
+        for i, (status, body) in enumerate(results):
+            body = body if isinstance(body, dict) else {}
+            replicas.append(
+                {
+                    "replica": i,
+                    "reached": status is not None,
+                    "status": status,
+                    "applied": bool(body.get("applied")),
+                    "rolled_back": bool(body.get("rolled_back")),
+                    "generation": (body.get("swap") or {}).get(
+                        "generation", body.get("generation")
+                    ),
+                    "reason": (body.get("plan") or {}).get("reason")
+                    or body.get("error"),
+                }
+            )
+        return {
+            "dry_run": dry_run,
+            "force": force,
+            "applied": sum(1 for r in replicas if r["applied"]),
+            "replicas": replicas,
+        }
+
     def _replica_prefixes(self) -> List[str]:
         """Per-replica ``.../gordo/v0/<project>`` prefixes, derived from
         the metrics scrape targets (the authoritative replica set)."""
@@ -860,11 +919,31 @@ def build_watchman_app(
         )
         return web.json_response(await state.fleet_slo(refresh=refresh))
 
+    async def rebalance(request: web.Request) -> web.Response:
+        """Fleet rebalance fan-out: forward ``POST /rebalance`` to every
+        replica (``?dry_run=1`` previews; JSON body ``{"force": true}``
+        forwards the operator override) and aggregate the verdicts."""
+        dry_run = request.query.get("dry_run", "").lower() in (
+            "1", "true", "yes",
+        )
+        force = False
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                body = None
+            if isinstance(body, dict):
+                force = bool(body.get("force", False))
+        return web.json_response(
+            await state.fleet_rebalance(dry_run=dry_run, force=force)
+        )
+
     app.router.add_get("/", root)
     app.router.add_get("/healthcheck", healthcheck)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
     app.router.add_get("/slo", slo)
+    app.router.add_post("/rebalance", rebalance)
     return app
 
 

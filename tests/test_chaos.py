@@ -32,6 +32,7 @@ pytestmark = pytest.mark.chaos
 EXPECTED_SITES = {
     "bank.finalize",
     "bank.quantize",  # driven in tests/test_bank_quantized.py (chaos mark)
+    "bank.swap",  # driven in tests/test_placement.py (chaos mark)
     "bank.score",
     "checkpoint.read",
     "checkpoint.write",
@@ -125,6 +126,7 @@ def test_every_failure_site_is_registered():
     # importing the subsystems registers their sites at module import
     import gordo_components_tpu.builder.fleet_build  # noqa: F401
     import gordo_components_tpu.parallel.checkpoint  # noqa: F401
+    import gordo_components_tpu.placement.swap  # noqa: F401
     import gordo_components_tpu.server.bank  # noqa: F401
     import gordo_components_tpu.server.model_io  # noqa: F401
     import gordo_components_tpu.watchman.server  # noqa: F401

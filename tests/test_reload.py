@@ -92,6 +92,54 @@ async def test_reload_noop(root):
         }
 
 
+async def test_reload_swaps_generation_with_zero_non_200s(root):
+    """/reload rides the placement swap primitive (placement/swap.py):
+    the replacement bank builds+warms off to the side and one generation
+    flip moves serving over, so a continuous scoring load across a
+    reload observes ONLY 200s — no 5xx window, no dropped request —
+    while the bank generation bumps and the reload response reports the
+    flip pause."""
+    import asyncio
+
+    serializer.dump(_make_det(1), str(root / "m-b"), metadata={"name": "m-b"})
+    async with make_client(root) as client:
+        X = [[0.1, 0.2, 0.3]] * 4
+        statuses: list = []
+        stop = asyncio.Event()
+
+        async def continuous_load():
+            i = 0
+            while not stop.is_set():
+                name = ("m-a", "m-b")[i % 2]
+                i += 1
+                resp = await client.post(
+                    f"/gordo/v0/p/{name}/anomaly/prediction", json={"X": X}
+                )
+                statuses.append(resp.status)
+                await resp.release()
+
+        loaders = [asyncio.create_task(continuous_load()) for _ in range(3)]
+        try:
+            for gen in (1, 2):
+                body = await (await client.post("/gordo/v0/p/reload")).json()
+                assert body["swap"]["generation"] == gen, body
+                assert body["swap"]["pause_ms"] < 250.0, body
+            # let the load observe the final generation for a few rounds
+            await asyncio.sleep(0.2)
+        finally:
+            stop.set()
+            await asyncio.gather(*loaders)
+        assert statuses and set(statuses) == {200}, (
+            sorted(set(statuses)), len(statuses),
+        )
+        app = client.server.app
+        assert app["bank_generation"] == 2
+        assert app["bank"].generation == 2
+        # the generation gauge agrees with the app pointer
+        snap = app["metrics"].snapshot()
+        assert snap["gordo_bank_generation"]["values"][0]["value"] == 2
+
+
 async def test_reload_isolates_corrupt_artifact(root):
     """A corrupt/mid-write artifact (builders race reloads in a live
     fleet) must not block reloading everything else: good artifacts load,
