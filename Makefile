@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance hotloop perf-guard trace-demo slo-demo rebalance-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -48,6 +48,15 @@ rebalance:
 	$(PYTHON) -m pytest tests/ -q -m rebalance --continue-on-collection-errors
 	$(PYTHON) -m pytest tests/test_reload.py -q -k zero_non_200
 
+# streaming lane: the ingestion & online adaptation plane — window
+# buffers/watermarks/late-row accounting, drift detection flagging
+# exactly the shifted members, the recalibrate/refit -> zero-downtime
+# generation swap acceptance (zero non-200s under concurrent load, FP
+# rate drops), the stream.ingest/stream.refit chaos rollbacks, and the
+# GORDO_STREAM=0 default-off contract (tests/test_streaming.py)
+stream:
+	$(PYTHON) -m pytest tests/ -q -m stream --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -78,6 +87,13 @@ slo-demo:
 # prints shard skew before/after and the flip pause (tools/rebalance_demo.py)
 rebalance-demo:
 	$(PYTHON) tools/rebalance_demo.py
+
+# live-stream loop on a small fleet: inject a mean-shift drift -> watch
+# detection flag exactly the shifted members -> recalibrate (and refit)
+# through the zero-downtime swap -> FP rate drops; prints one JSON doc
+# (tools/stream_demo.py; bench.py's `streaming` leg runs the same tool)
+stream-demo:
+	$(PYTHON) tools/stream_demo.py
 
 bench:
 	$(PYTHON) bench.py

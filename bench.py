@@ -764,6 +764,52 @@ def bench_rebalance(members=256, devices=8, hot_weight=8, request_rows=64):
     }
 
 
+def bench_streaming(members=6, rows=96, epochs=3, mean_shift=4.0):
+    """Streaming & online adaptation (ISSUE 9) — the live loop over the
+    real HTTP surface: inject a mean-shift drift into K members of a
+    heterogeneous fleet, watch detection flag exactly those members,
+    recalibrate + incrementally refit through the zero-downtime swap,
+    and verify the false-positive rate on shifted-but-healthy data
+    drops. Runs in a subprocess (the env knobs must land before the
+    server module reads them) via tools/stream_demo.py."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "stream_demo.py"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, tool, "--members", str(members),
+            "--rows", str(rows), "--epochs", str(epochs),
+            "--mean-shift", str(mean_shift), "--platform", "cpu",
+        ],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"stream demo failed: {' | '.join(tail[-3:])}")
+    # same JSON-tail parse as the rebalance leg: the document is the last
+    # block whose opening line is a bare "{"
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    assert doc["fp_rate_drop"] > 0.25, doc
+    assert max(doc["fp_rate_after"].values()) < max(
+        doc["fp_rate_before"].values()
+    ), doc
+    return {
+        "streaming_members": doc["members"],
+        "streaming_detection_latency_s": doc["detection_latency_s"],
+        "streaming_recalibration_s": doc["recalibration_s"],
+        "streaming_refit_s": doc["refit_s"],
+        "streaming_swap_pause_ms": doc["swap_pause_ms"],
+        "streaming_fp_rate_before": max(doc["fp_rate_before"].values()),
+        "streaming_fp_rate_after": max(doc["fp_rate_after"].values()),
+        "streaming_fp_rate_drop": doc["fp_rate_drop"],
+        "streaming_generations": doc["generation_after_refit"],
+        "streaming": doc,
+    }
+
+
 def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
     """Config 5 extension — sequence models served from the HBM bank
     (windowing runs in-graph with the bucket's static lookback)."""
@@ -1280,6 +1326,7 @@ METRICS = (
     ("bank_capacity", bench_bank_capacity),
     ("bank_sequence", bench_bank_sequence),
     ("rebalance", bench_rebalance),
+    ("streaming", bench_streaming),
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
@@ -1306,6 +1353,7 @@ CPU_KWARGS = {
     "bank_capacity": dict(n_models=3, rows=128, iters=4),
     "bank_sequence": dict(n_models=8, iters=5),
     "rebalance": dict(members=64, request_rows=32),
+    "streaming": dict(members=4, rows=64, epochs=2),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
     # the full 10k leg takes ~2.5 min on one core (measured; most of it

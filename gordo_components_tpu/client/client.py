@@ -161,6 +161,10 @@ class Client:
         # traceable end to end (client log line <-> server histogram entry)
         self._rid_prefix = uuid.uuid4().hex[:12]
         self._rid_seq = itertools.count(1)
+        # streaming-forwarder accounting (ingest_async): rows accepted by
+        # the server's window buffers, exposed as
+        # gordo_client_ingest_rows_total through the collector below
+        self._ingest_stats: Dict[str, int] = {"rows": 0, "chunks": 0}
         # after _rid_prefix: the metric series are labeled by it
         self._register_metrics()
 
@@ -211,6 +215,11 @@ class Client:
                 "gordo_client_hedge_wins_total", "counter",
                 "Hedged requests answered by the hedge replica first",
                 labels, c._hedge_stats["hedge_wins"],
+            )
+            yield (
+                "gordo_client_ingest_rows_total", "counter",
+                "Stream rows the ingestion forwarder posted and the "
+                "server accepted", labels, c._ingest_stats["rows"],
             )
 
         get_registry().collector(collect, key=f"bulk_client:{self._rid_prefix}")
@@ -572,3 +581,87 @@ class Client:
                 frames.append(df)
         predictions = pd.concat(frames) if frames else None
         return PredictionResult(target, predictions, errors)
+
+    # ------------------------------------------------------------------ #
+    # streaming forwarder
+    # ------------------------------------------------------------------ #
+
+    def ingest(self, target: str, X, timestamps=None) -> Dict[str, int]:
+        """Synchronous wrapper over :meth:`ingest_async`."""
+        return asyncio.run(self.ingest_async(target, X, timestamps))
+
+    async def ingest_async(
+        self, target: str, X, timestamps=None
+    ) -> Dict[str, int]:
+        """Streaming forwarder: POST fresh rows to the server's
+        ``.../{target}/ingest`` window buffer in ``batch_size``-row
+        chunks, reusing the scoring path's transport citizenship — the
+        per-chunk deadline rides the wire as ``X-Gordo-Deadline-Ms``
+        (restamped per retry attempt) and every retry spends the SAME
+        shared :class:`RetryBudget` the scoring POSTs draw from, so an
+        ingest storm cannot re-offer unbounded load either. NaN cells
+        (sensor dropout) serialize as JSON ``null``.
+
+        ``X``: DataFrame (index supplies event timestamps unless
+        ``timestamps`` is given) or (rows, features) array.
+        Returns the summed server accounting
+        (``accepted``/``late``/``dropped`` rows + chunks posted) and
+        feeds ``gordo_client_ingest_rows_total``.
+
+        Delivery is AT-LEAST-ONCE: a chunk the server ingested whose
+        response was lost gets retried and its rows ingested twice.
+        That is the right trade for a drift window (a few duplicated
+        rows barely move an EWMA/quantile; silently LOSING fresh rows
+        starves detection) — but it means ``rows_total`` is an upper
+        bound on distinct rows, not an exact count."""
+        if isinstance(X, pd.DataFrame):
+            values = X.values
+            if timestamps is None and isinstance(X.index, pd.DatetimeIndex):
+                # only a datetime index carries event times; a default
+                # RangeIndex would serialize as unparseable "0","1",...
+                # — omit instead, the server stamps arrival time
+                timestamps = [str(i) for i in X.index]
+        else:
+            import numpy as np
+
+            values = np.asarray(X)
+        totals = {"accepted": 0, "late": 0, "dropped": 0, "chunks": 0}
+        url = self._url(target, "ingest")
+        timeout = aiohttp.ClientTimeout(total=600)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            for i in range(0, len(values), self.batch_size):
+                chunk = values[i : i + self.batch_size]
+                rows = [
+                    [None if v != v else float(v) for v in row]
+                    for row in chunk.tolist()
+                ]
+                payload: Dict[str, Any] = {"rows": rows}
+                if timestamps is not None:
+                    ts = list(timestamps[i : i + self.batch_size])
+                    payload["timestamps"] = [
+                        t if isinstance(t, (int, float, str)) else str(t)
+                        for t in ts
+                    ]
+                rid = self._next_request_id()
+                deadline = (
+                    Deadline.after_ms(self.deadline_ms)
+                    if self.deadline_ms is not None
+                    else None
+                )
+                body = await fetch_json(
+                    session,
+                    url,
+                    method="POST",
+                    json_payload=payload,
+                    headers=self._trace_headers(rid),
+                    retries=self.retries,
+                    backoff=self.backoff,
+                    retry_budget=self.retry_budget,
+                    deadline=deadline,
+                )
+                totals["chunks"] += 1
+                for key in ("accepted", "late", "dropped"):
+                    totals[key] += int(body.get(key, 0))
+                self._ingest_stats["rows"] += int(body.get("accepted", 0))
+                self._ingest_stats["chunks"] += 1
+        return totals

@@ -12,6 +12,9 @@ from gordo_components_tpu.dataset.data_provider.providers import (
     InfluxDataProvider,
     RandomDataProvider,
 )
+from gordo_components_tpu.dataset.data_provider.streaming import (
+    SimulatedLiveProvider,
+)
 
 __all__ = [
     "GordoBaseDataProvider",
@@ -21,4 +24,5 @@ __all__ = [
     "DataLakeProvider",
     "NcsReader",
     "IrocReader",
+    "SimulatedLiveProvider",
 ]

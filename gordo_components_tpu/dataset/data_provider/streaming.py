@@ -1,0 +1,178 @@
+"""Simulated live-stream provider (drift-injectable).
+
+The reference system's real workload is a continuous sensor stream
+(InfluxDB-backed ``TimeSeriesDataset``); this repo's serving side grew a
+streaming ingestion plane (``gordo_components_tpu/streaming/``) that
+needs a deterministic live source to drive tests, ``tools/stream_demo.py``
+and the bench ``streaming`` leg without a broker in the image.
+
+:class:`SimulatedLiveProvider` wraps :class:`RandomDataProvider`'s
+per-tag sine generator (so data "streamed" for a time range is the same
+distribution a model trained on that generator saw) and adds the failure
+modes the concept-drift scenario family needs, each injectable at a
+point in event time:
+
+- **mean shift** — a constant offset on selected tags;
+- **variance inflation** — noise scaled up around the signal;
+- **sensor dropout** — per-cell NaNs at a seeded probability;
+- **late data** — a seeded fraction of each batch is withheld and
+  delivered at the END of the batch (out-of-order event timestamps),
+  exercising the ingestor's watermark/late-row accounting.
+
+Everything is deterministic in ``(seed, batch start)``: a drift test or
+bench run replays identically.
+"""
+
+import hashlib
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from gordo_components_tpu.dataset.data_provider.base import GordoBaseDataProvider
+from gordo_components_tpu.dataset.data_provider.providers import RandomDataProvider
+from gordo_components_tpu.dataset.sensor_tag import SensorTag, normalize_sensor_tags
+from gordo_components_tpu.utils import capture_args
+
+
+class SimulatedLiveProvider(GordoBaseDataProvider):
+    """Deterministic synthetic live stream over the RandomDataProvider
+    signal family, with drift injection.
+
+    ``load_series`` serves the (undrifted) base signal, so a
+    ``TimeSeriesDataset`` over this provider trains on exactly the
+    healthy distribution the stream later drifts away from. ``batch``
+    produces the live rows: (event timestamps, values) at ``freq``,
+    with the currently injected drift applied."""
+
+    io_bound = False  # pure host compute, like RandomDataProvider
+
+    @capture_args
+    def __init__(self, freq: str = "10s", noise: float = 0.1, seed: int = 0):
+        self.freq = freq
+        self.noise = float(noise)
+        self.seed = int(seed)
+        self._base = RandomDataProvider(freq=freq, noise=noise, seed=seed)
+        # injected drift state (None = healthy). Tags is None = all tags.
+        self._drift: Optional[dict] = None
+
+    # ------------------------- provider contract ----------------------- #
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return True
+
+    def load_series(
+        self,
+        from_ts: pd.Timestamp,
+        to_ts: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        """The HEALTHY base signal (training-side view): drift is a
+        property of the live stream, never of the training range."""
+        return self._base.load_series(from_ts, to_ts, tag_list, dry_run)
+
+    # --------------------------- drift control ------------------------- #
+
+    def inject(
+        self,
+        mean_shift: float = 0.0,
+        var_inflation: float = 1.0,
+        dropout_p: float = 0.0,
+        late_fraction: float = 0.0,
+        tags: Optional[List[str]] = None,
+    ) -> None:
+        """Arm drift for subsequent ``batch`` calls. ``tags`` restricts
+        mean shift / variance inflation to the named tags (dropout and
+        lateness are row/cell-level and apply to the whole stream)."""
+        self._drift = {
+            "mean_shift": float(mean_shift),
+            "var_inflation": float(var_inflation),
+            "dropout_p": float(dropout_p),
+            "late_fraction": float(late_fraction),
+            "tags": None if tags is None else set(tags),
+        }
+
+    def clear(self) -> None:
+        self._drift = None
+
+    # ----------------------------- the stream -------------------------- #
+
+    def batch(
+        self,
+        start: pd.Timestamp,
+        n_rows: int,
+        tag_list: List,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One live batch: ``(event_ts, values)`` where ``event_ts`` is
+        (n,) float epoch seconds and ``values`` (n, n_tags) float32 with
+        NaNs for dropped-out sensor cells.
+
+        Rows are emitted in ARRIVAL order: with ``late_fraction`` armed,
+        a seeded subset of rows is withheld and appended at the end of
+        the batch with their original (old) event timestamps — the
+        ingestor sees them as out-of-order/late rows behind its
+        watermark, exactly like a flaky field gateway flushing its
+        buffer."""
+        tags = normalize_sensor_tags(list(tag_list))
+        start = pd.Timestamp(start)
+        if start.tzinfo is None:
+            start = start.tz_localize("UTC")
+        step = pd.Timedelta(self.freq)
+        end = start + step * n_rows
+        series = list(self._base.load_series(start, end, tags))
+        values = np.stack(
+            [np.asarray(s.values[:n_rows], np.float32) for s in series], axis=1
+        )
+        index = series[0].index[:n_rows]
+        # asi8 is in the index's own unit; pin ns before the /1e9
+        event_ts = index.as_unit("ns").asi8.astype(np.float64) / 1e9
+
+        drift = self._drift
+        if drift is not None:
+            rng = self._batch_rng(start)
+            cols = [
+                i
+                for i, t in enumerate(tags)
+                if drift["tags"] is None or t.name in drift["tags"]
+            ]
+            if drift["var_inflation"] != 1.0 and cols:
+                mu = np.nanmean(values[:, cols], axis=0, keepdims=True)
+                values[:, cols] = mu + (values[:, cols] - mu) * np.float32(
+                    np.sqrt(drift["var_inflation"])
+                )
+            if drift["mean_shift"] and cols:
+                values[:, cols] += np.float32(drift["mean_shift"])
+            if drift["dropout_p"] > 0:
+                mask = rng.random(values.shape) < drift["dropout_p"]
+                values[mask] = np.nan
+            if drift["late_fraction"] > 0 and n_rows > 1:
+                late = rng.random(n_rows) < drift["late_fraction"]
+                order = np.concatenate(
+                    [np.flatnonzero(~late), np.flatnonzero(late)]
+                )
+                values = values[order]
+                event_ts = event_ts[order]
+        return event_ts, values
+
+    def frame(self, start: pd.Timestamp, n_rows: int, tag_list: List) -> pd.DataFrame:
+        """Convenience: one batch as a tag-columned DataFrame (arrival
+        order; index = event time). Used to TRAIN matched-distribution
+        detectors in tests/demos — fit on a healthy ``frame``, stream
+        drifted ``batch`` rows at the same resolution."""
+        tags = normalize_sensor_tags(list(tag_list))
+        ts, values = self.batch(start, n_rows, tags)
+        index = pd.to_datetime((ts * 1e9).astype("int64"), utc=True)
+        return pd.DataFrame(
+            values, index=index, columns=[t.name for t in tags]
+        )
+
+    def _batch_rng(self, start: pd.Timestamp) -> np.random.Generator:
+        """Seeded per (provider seed, batch start): replay-identical,
+        and consecutive batches draw independent dropout/late patterns."""
+        digest = hashlib.sha256(
+            f"{self.seed}|{start.isoformat()}".encode()
+        ).digest()
+        return np.random.Generator(
+            np.random.Philox(key=int.from_bytes(digest[:16], "little"))
+        )

@@ -190,10 +190,17 @@ class RandomDataset(TimeSeriesDataset):
         train_start_date: Union[str, pd.Timestamp] = "2017-12-25 06:00:00Z",
         train_end_date: Union[str, pd.Timestamp] = "2017-12-29 06:00:00Z",
         tag_list: Optional[List] = None,
+        seed: int = 0,
         **kwargs,
     ):
         tag_list = tag_list or [f"tag-{i}" for i in range(10)]
-        kwargs.setdefault("data_provider", RandomDataProvider())
+        # explicit seed threaded end to end to the provider: the streaming
+        # simulator and drift-injection tests need bit-identical data at
+        # equal seed (and DIFFERENT data at different seeds) without
+        # constructing the provider by hand. An explicitly passed
+        # data_provider wins — its own seed is authoritative then.
+        kwargs.setdefault("data_provider", RandomDataProvider(seed=seed))
+        self.seed = int(seed)
         super().__init__(
             train_start_date=train_start_date,
             train_end_date=train_end_date,
@@ -204,6 +211,7 @@ class RandomDataset(TimeSeriesDataset):
             "train_start_date": str(train_start_date),
             "train_end_date": str(train_end_date),
             "tag_list": tag_list,
+            "seed": self.seed,
             **{k: v for k, v in kwargs.items() if k != "data_provider"},
         }
 

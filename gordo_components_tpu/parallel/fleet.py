@@ -863,6 +863,7 @@ class FleetTrainer:
         self,
         members: Dict[str, np.ndarray],
         member_hparams: Optional[Dict[str, Dict[str, Any]]] = None,
+        initial_params: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, FleetMemberModel]:
         """``members``: name -> (n_rows_i, n_features_i) float array.
         Returns name -> FleetMemberModel. One compiled program per
@@ -877,6 +878,14 @@ class FleetTrainer:
         (SURVEY.md §7 hard part 4: per-model LR). A patience override
         requires ES to be enabled on the trainer — silently enabling it
         for one member would change the gang's program shape.
+
+        ``initial_params``: optional name -> params pytree WARM START —
+        the member's row of the stacked init is overwritten with the
+        given leaves (optimizer state stays fresh), so a short
+        ``epochs`` run fine-tunes serving weights on fresh data instead
+        of training from scratch (the streaming plane's incremental
+        refit). Trees must match the gang's architecture exactly; a
+        structure or shape mismatch fails fast naming the member.
         """
         t0 = time.time()
         # fleet-build progress, published to the process metrics registry
@@ -914,6 +923,10 @@ class FleetTrainer:
                     "but the trainer has ES disabled"
                 )
             self._member_hparams[name] = dict(hp)
+        for name in initial_params or {}:
+            if name not in members:
+                raise ValueError(f"initial_params for unknown member {name!r}")
+        self._initial_params = dict(initial_params or {})
         buckets: Dict[Tuple[int, int], List[str]] = {}
         # accept DataFrames: keep tag names for the anomaly contract
         self._tags_map = {
@@ -1149,6 +1162,47 @@ class FleetTrainer:
         sample = Xd[:, 0, :] if seq is None else Xd[:, : self.lookback_window, :]
         states = init_stacked(rngs, sample)
 
+        # ---- warm start (incremental refit): overwrite the stacked init's
+        # member rows with the provided serving weights. Mesh-padding
+        # dummies replicate their source member's warm leaves (i % M_real),
+        # like the data; the optimizer state stays freshly initialized ----
+        warm = getattr(self, "_initial_params", None) or {}
+        if any(names[i % M_real] in warm for i in range(M)):
+            host = jax.tree.map(np.array, states.params)
+            treedef = jax.tree.structure(host)
+            leaves = jax.tree.leaves(host)
+            warm_leaves: Dict[str, List[np.ndarray]] = {}
+            for name in set(names) & set(warm):
+                tree = jax.tree.map(np.asarray, warm[name])
+                if jax.tree.structure(tree) != treedef:
+                    raise ValueError(
+                        f"initial_params[{name!r}]: tree structure does not "
+                        "match this gang's architecture"
+                    )
+                wl = jax.tree.leaves(tree)
+                for li, leaf in enumerate(leaves):
+                    if wl[li].shape != leaf.shape[1:]:
+                        raise ValueError(
+                            f"initial_params[{name!r}]: leaf {li} shape "
+                            f"{wl[li].shape} != expected {leaf.shape[1:]}"
+                        )
+                warm_leaves[name] = wl
+            for i in range(M):
+                wl = warm_leaves.get(names[i % M_real])
+                if wl is None:
+                    continue
+                for li, leaf in enumerate(leaves):
+                    leaf[i] = wl[li]
+            states = states._replace(
+                params=jax.tree.unflatten(
+                    treedef,
+                    [
+                        jax.device_put(jnp.asarray(leaf), sharding)
+                        for leaf in leaves
+                    ],
+                )
+            )
+
         # ---- per-member hyperparameter vectors (mesh-padding dummies
         # replicate their source member's values, like the data) ----
         hparams = getattr(self, "_member_hparams", {})
@@ -1227,6 +1281,11 @@ class FleetTrainer:
                         for n, hp in hparams.items()
                         if n in names
                     ),
+                    # warm-started members change the trajectory: a resume
+                    # must not mix a warm run with a cold one (content is
+                    # not keyed — refits don't checkpoint in practice, and
+                    # the member names + data hash bound the blast radius)
+                    sorted(n for n in warm if n in names),
                     self.optimizer,
                     self.early_stopping_patience,
                     self.early_stopping_min_delta,

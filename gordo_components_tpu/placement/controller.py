@@ -254,13 +254,11 @@ class PlacementController:
             self._pause_hist.record(result.pause_s)
 
     def _lock(self) -> asyncio.Lock:
-        # the same lock /reload serializes under (views.py): both paths
-        # rebuild the bank, and two concurrent rebuilds would race the
-        # generation flip AND double device memory twice over
-        lock = self.app.get("reload_lock")
-        if lock is None:
-            lock = self.app["reload_lock"] = asyncio.Lock()
-        return lock
+        # the same lock /reload and the streaming plane serialize under:
+        # every bank-rebuilding path shares it (server/utils.py)
+        from gordo_components_tpu.server.utils import get_reload_lock
+
+        return get_reload_lock(self.app)
 
     async def rebalance(
         self, force: bool = False, dry_run: bool = False

@@ -505,6 +505,27 @@ def build_app(
 
             app.on_startup.append(_start_engine)
 
+    # streaming ingestion & online adaptation plane (streaming/):
+    # DEFAULT OFF (GORDO_STREAM=0) — the scoring hot path is untouched
+    # and no gordo_stream_*/gordo_drift_* series appear (the contract
+    # tests/test_streaming.py's hot-loop guard holds). When enabled, the
+    # server accumulates fresh windows via POST .../{target}/ingest,
+    # detects drift (GET .../drift), and recalibrates/refits through the
+    # zero-downtime swap; GORDO_STREAM_ADAPT=auto arms the background loop
+    if os.environ.get("GORDO_STREAM", "0") not in ("0", "", "false"):
+        from gordo_components_tpu.streaming import StreamingPlane
+
+        app["stream"] = StreamingPlane(app)
+
+        async def _start_stream(app: web.Application) -> None:
+            app["stream"].start()
+
+        async def _stop_stream(app: web.Application) -> None:
+            await app["stream"].stop()
+
+        app.on_startup.append(_start_stream)
+        app.on_cleanup.append(_stop_stream)
+
     if ledger is not None:
         # background SLO sampling cadence: the tracker also samples
         # lazily on reads, but a replica nobody is scraping must still

@@ -167,6 +167,43 @@ class ModelCollection:
             "failed": failed,
         }
 
+    def publish(
+        self, updates: Dict[str, Any], note: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Atomically publish in-memory model replacements (the streaming
+        adaptation plane's recalibration/refit path — no artifact write).
+
+        Only names already in the collection may be replaced: new members
+        arrive via artifacts + :meth:`refresh`. The replacement persists
+        across refreshes until the on-disk artifact's mtime changes (a
+        rebuilt artifact is newer truth and wins). ``note`` (optional) is
+        merged into each replaced member's metadata under
+        ``online-adaptation`` so ``/metadata`` shows that — and when —
+        the serving calibration diverged from the artifact."""
+        unknown = [n for n in updates if n not in self.models]
+        if unknown:
+            raise KeyError(f"cannot publish unknown members: {sorted(unknown)}")
+        models, metadata = dict(self.models), dict(self.metadata)
+        for name, model in updates.items():
+            models[name] = model
+            if note is not None:
+                meta = dict(metadata.get(name, {}))
+                meta["online-adaptation"] = {
+                    **note,
+                    "total-anomaly-threshold": getattr(
+                        model, "total_threshold_", None
+                    ),
+                    "threshold-method": getattr(model, "threshold_method_", None),
+                }
+                metadata[name] = meta
+        self._state = (models, metadata)  # atomic publish
+
+    def restore(self, state: tuple) -> None:
+        """Roll back to a snapshot taken before :meth:`publish` (the
+        adaptation plane's failed-swap path). The tuple is published
+        as-is — snapshots are immutable by the ``_state`` contract."""
+        self._state = state
+
     @staticmethod
     def _load_one(models: Dict, metadata: Dict, name: str, path: str) -> None:
         logger.info("Loading model %r from %s", name, path)

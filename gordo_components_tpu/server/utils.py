@@ -13,6 +13,22 @@ import numpy as np
 import pandas as pd
 
 
+def get_reload_lock(app):
+    """The app's bank-rebuild serialization lock, created lazily on the
+    event loop (aiohttp handlers share one loop thread and there is no
+    await between check and set, so the init is race-free). Every path
+    that rebuilds the bank — ``/reload``, the placement controller, the
+    streaming adaptation plane — MUST serialize under this one lock:
+    two concurrent rebuilds would race the generation flip and double
+    device memory twice over."""
+    import asyncio
+
+    lock = app.get("reload_lock")
+    if lock is None:
+        lock = app["reload_lock"] = asyncio.Lock()
+    return lock
+
+
 def frame_to_dict(df: pd.DataFrame) -> Dict[str, Any]:
     """Multi-level (or flat) column DataFrame -> nested JSON-able dict:
     ``{"data": {top: {sub: [values]}}, "index": [...]}}``."""

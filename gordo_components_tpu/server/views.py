@@ -30,7 +30,11 @@ from gordo_components_tpu import __version__, serializer
 from gordo_components_tpu.observability.tracing import chrome_trace
 from gordo_components_tpu.resilience.deadline import DeadlineExceeded
 from gordo_components_tpu.server.bank import EngineOverloaded
-from gordo_components_tpu.server.utils import extract_x_y, frame_to_dict
+from gordo_components_tpu.server.utils import (
+    extract_x_y,
+    frame_to_dict,
+    get_reload_lock,
+)
 from gordo_components_tpu.utils import parquet_engine_available
 
 logger = logging.getLogger(__name__)
@@ -577,11 +581,7 @@ async def reload_models(request: web.Request) -> web.Response:
     models/metadata under readers) and each would rebuild the full HBM
     bank — making repeated POSTs a cheap DoS on device memory/compute."""
     app = request.app
-    # aiohttp handlers all run on the one event loop thread, and there is
-    # no await between the check and the set, so this lazy init is safe
-    lock = app.get("reload_lock")
-    if lock is None:
-        lock = app["reload_lock"] = asyncio.Lock()
+    lock = get_reload_lock(app)
     collection = _collection(request)
     loop = asyncio.get_running_loop()
     async with lock:
@@ -697,6 +697,146 @@ async def rebalance(request: web.Request) -> web.Response:
         # is serving, nothing was dropped — the 500 reports the failed
         # ATTEMPT, not a degraded server
         logger.exception("rebalance failed (rolled back)")
+        return web.json_response(
+            {
+                "error": f"{type(exc).__name__}: {exc}",
+                "rolled_back": True,
+                "generation": int(request.app.get("bank_generation", 0)),
+                "request_id": request.get("request_id"),
+            },
+            status=500,
+        )
+    return web.json_response(result)
+
+
+def _stream_plane(request: web.Request):
+    """The streaming adaptation plane, or a 404 naming the knob — a
+    plain 404 would read as a typo'd URL, not a disabled feature."""
+    plane = request.app.get("stream")
+    if plane is None:
+        raise web.HTTPNotFound(
+            text=json.dumps(
+                {"error": "streaming plane not enabled (GORDO_STREAM=0)"}
+            ),
+            content_type="application/json",
+        )
+    return plane
+
+
+@routes.get("/gordo/v0/{project}/drift")
+async def drift_view(request: web.Request) -> web.Response:
+    """Per-member drift state over the streaming window buffers
+    (streaming/drift.py): EWMA reconstruction-error drift vs the
+    train-time thresholds, input out-of-training-range fraction,
+    watermark lag and staleness, plus the currently drifted member list.
+    ``?refresh=1`` runs a fresh evaluation sweep first (device work, off
+    the event loop); the default serves the last sweep's state."""
+    plane = request.app.get("stream")
+    if plane is None:
+        return web.json_response({"enabled": False})
+    if request.query.get("refresh", "").lower() in ("1", "true", "yes"):
+        await plane.evaluate()
+    return web.json_response({"enabled": True, **plane.drift_view()})
+
+
+@routes.post("/gordo/v0/{project}/{target}/ingest")
+async def ingest_rows(request: web.Request) -> web.Response:
+    """Streaming ingestion: append fresh rows to the target's window
+    buffer. Body: ``{"rows": [[...], ...], "timestamps": [...]}`` —
+    timestamps are epoch seconds or ISO-8601 strings (optional: absent
+    means "arrived now"); ``null`` cells mark sensor dropout. Late rows
+    (behind the watermark by more than ``GORDO_STREAM_LATENESS_S``) are
+    counted and dropped, out-of-order rows within the allowance are
+    accepted — the response reports both."""
+    plane = _stream_plane(request)
+    _get_model(request)  # 404 for unknown targets, same as scoring
+    target = request.match_info["target"]
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "expected a JSON body with rows"}),
+            content_type="application/json",
+        )
+    rows = body.get("rows") if isinstance(body, dict) else None
+    if not isinstance(rows, list) or not rows:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "rows must be a non-empty list of lists"}),
+            content_type="application/json",
+        )
+    try:
+        values = np.asarray(
+            [[np.nan if v is None else v for v in r] for r in rows],
+            dtype=np.float32,
+        )
+        raw_ts = body.get("timestamps")
+        if raw_ts is None:
+            event_ts = np.full((len(values),), time.time())
+        elif not isinstance(raw_ts, list):
+            raise ValueError("timestamps must be a list")
+        elif len(raw_ts) != len(values):
+            raise ValueError(
+                f"{len(raw_ts)} timestamps for {len(values)} rows"
+            )
+        elif raw_ts and isinstance(raw_ts[0], str):
+            # asi8 is in the index's own unit (ns/us/ms/s in pandas 2.x
+            # — see dataset/resample.py); normalize to ns first
+            event_ts = (
+                pd.to_datetime(raw_ts, utc=True).as_unit("ns").asi8 / 1e9
+            )
+        else:
+            event_ts = np.asarray(raw_ts, np.float64)
+        counts = plane.ingest(target, event_ts, values)
+    except ValueError as exc:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": str(exc)}), content_type="application/json"
+        )
+    return web.json_response({"target": target, **counts})
+
+
+@routes.post("/gordo/v0/{project}/adapt")
+async def adapt(request: web.Request) -> web.Response:
+    """Apply the online adaptation: recalibrate (default) or
+    incrementally refit the drifted members (or an explicit ``targets``
+    list) and land the result as a new bank generation through the
+    zero-downtime swap. Body (optional JSON):
+    ``{"mode": "recalibrate"|"refit", "targets": ["name", ...]}``.
+    A failed adaptation rolls back completely — the serving generation
+    is untouched — and answers 500 with ``rolled_back``."""
+    plane = _stream_plane(request)
+    mode, targets = "recalibrate", None
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "expected a JSON body"}),
+                content_type="application/json",
+            )
+        if isinstance(body, dict):
+            mode = body.get("mode", "recalibrate")
+            targets = body.get("targets")
+        elif body:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "expected a JSON object body"}),
+                content_type="application/json",
+            )
+    if mode not in ("recalibrate", "refit"):
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"mode must be recalibrate|refit, got {mode!r}"}),
+            content_type="application/json",
+        )
+    if targets is not None and not isinstance(targets, list):
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "targets must be a list"}),
+            content_type="application/json",
+        )
+    try:
+        result = await plane.adapt(mode, targets=targets)
+    except Exception as exc:
+        # the rollback contract already ran (streaming/adapt.py): the
+        # serving generation and the published models are untouched
+        logger.exception("adaptation failed (rolled back)")
         return web.json_response(
             {
                 "error": f"{type(exc).__name__}: {exc}",

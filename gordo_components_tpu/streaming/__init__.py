@@ -1,0 +1,31 @@
+"""Streaming ingestion & online adaptation plane.
+
+The batch world (build fleet -> serve statically) misses the reference
+system's real workload: continuous sensor streams whose distribution
+drifts. This package closes the loop on the serving side:
+
+- :mod:`ingest` — per-member bounded ring :class:`WindowBuffer` with
+  event-time watermarks, late/out-of-order accounting and sensor-dropout
+  masking, fed by ``POST .../{target}/ingest``;
+- :mod:`drift` — per-member detectors over those buffers (EWMA
+  reconstruction-error drift vs the train-time thresholds, input
+  out-of-training-range shift vs the train scaler stats, staleness),
+  surfaced via ``GET .../drift`` and the ``gordo_drift_score`` gauges;
+- :mod:`adapt` — the online loop: rolling EWMA threshold recalibration
+  on fresh windows (cheap, no retrain) and a scheduled incremental-refit
+  path that fine-tunes only drifted members for a few epochs via
+  ``FleetTrainer`` (warm-started from the serving weights), both landing
+  as a new bank generation through the zero-downtime swap
+  (``placement/swap.py``) — recalibration never causes a 5xx window.
+
+Default-off contract: ``GORDO_STREAM=0`` (the default) builds none of
+this — the scoring hot path is untouched and no ``gordo_stream_*`` /
+``gordo_drift_*`` series appear (held by the hot-loop guard in
+``tests/test_streaming.py``).
+"""
+
+from gordo_components_tpu.streaming.adapt import StreamingPlane
+from gordo_components_tpu.streaming.drift import DriftDetector
+from gordo_components_tpu.streaming.ingest import StreamIngestor, WindowBuffer
+
+__all__ = ["StreamingPlane", "DriftDetector", "StreamIngestor", "WindowBuffer"]

@@ -1,0 +1,477 @@
+"""The online adaptation loop: recalibrate cheaply, refit selectively,
+apply through the zero-downtime swap.
+
+Two response tiers, ordered by cost:
+
+1. **Rolling EWMA threshold recalibration** (no retrain): a drifted
+   member's error scaler is re-fit on its fresh window and EWMA-blended
+   with the serving scaler (``GORDO_RECAL_ALPHA`` weights the new
+   window), then the anomaly thresholds are re-derived from the window
+   under the blended scaler at the member's configured quantile. The
+   model's weights are untouched — only its idea of "how big is a
+   normal reconstruction error" moves, which is exactly what a mean
+   shift on healthy machinery miscalibrates.
+2. **Incremental refit** (bounded retrain): drifted members fine-tune
+   for ``GORDO_REFIT_EPOCHS`` epochs via ``FleetTrainer`` on their fresh
+   windows, warm-started from the serving weights (one gang per
+   architecture group), producing complete replacement detectors with
+   freshly fitted scalers and thresholds.
+
+Either path publishes the updated members into the live collection and
+applies them as a NEW BANK GENERATION through ``placement/swap.py`` —
+the same double-buffered flip ``/reload`` and the rebalancer ride, so an
+adaptation never causes a 5xx window. Failures roll back completely:
+the ``stream.refit`` faultpoint fires before training, and a failed
+build/swap restores the collection state and registry collectors, so
+the serving generation is untouched (chaos-tested).
+"""
+
+import asyncio
+import contextlib
+import copy
+import functools
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from gordo_components_tpu.resilience.faults import faultpoint
+from gordo_components_tpu.streaming.drift import DriftDetector
+from gordo_components_tpu.streaming.ingest import StreamIngestor
+from gordo_components_tpu.utils import env_num as _env_num
+
+logger = logging.getLogger(__name__)
+
+# chaos site (tests/test_streaming.py): fired at the head of the refit
+# path — a failed refit must leave the serving generation untouched
+_FP_REFIT = faultpoint("stream.refit")
+
+
+class StreamingPlane:
+    """One per serving app (``build_app`` attaches it as ``app["stream"]``
+    when ``GORDO_STREAM=1``). Owns the ingestor, the drift detector, the
+    adaptation entrypoints, the ``GORDO_STREAM_ADAPT=auto`` background
+    loop, and the ``gordo_stream_*`` / ``gordo_drift_*`` metric surface."""
+
+    def __init__(self, app):
+        self.app = app
+        self.ingestor = StreamIngestor(
+            capacity=_env_num("GORDO_STREAM_WINDOW", 512, int),
+            lateness_s=_env_num("GORDO_STREAM_LATENESS_S", 300.0, float),
+        )
+        self.detector = DriftDetector(
+            app,
+            self.ingestor,
+            threshold=_env_num("GORDO_DRIFT_THRESHOLD", 1.0, float),
+            alpha=_env_num("GORDO_DRIFT_ALPHA", 0.5, float),
+            min_rows=_env_num("GORDO_STREAM_MIN_ROWS", 32, int),
+        )
+        # EWMA weight of the fresh window in scaler recalibration
+        self.recal_alpha = _env_num("GORDO_RECAL_ALPHA", 0.5, float)
+        self.refit_epochs = _env_num("GORDO_REFIT_EPOCHS", 3, int)
+        # auto-loop refit gate: drift_score above this escalates from
+        # recalibration to refit (0 = the loop never refits on its own;
+        # POST /adapt {"mode": "refit"} still works)
+        self.refit_threshold = _env_num("GORDO_REFIT_THRESHOLD", 0.0, float)
+        self.interval_s = _env_num("GORDO_STREAM_INTERVAL_S", 30.0, float)
+        self.auto = (
+            os.environ.get("GORDO_STREAM_ADAPT", "").strip().lower() == "auto"
+        )
+        self._task: Optional[asyncio.Task] = None
+        self.stats: Dict[str, Any] = {
+            "adaptations": 0,
+            "recalibrated_members": 0,
+            "refit_members": 0,
+            "refit_failed": 0,
+            "last_mode": None,
+            "last_error": None,
+            "last_generation": None,
+        }
+        registry = app.get("metrics")
+        if registry is not None:
+            registry.collector(self._collect, key="stream")
+
+    # ------------------------- metric surface -------------------------- #
+
+    def _collect(self):
+        """Read-through exposition (stability contract,
+        docs/observability.md): the same integers ``GET /drift`` reports."""
+        totals = self.ingestor.totals()
+        yield (
+            "gordo_stream_rows_total", "counter",
+            "Ingested stream rows accepted into window buffers", {},
+            totals["rows_total"],
+        )
+        yield (
+            "gordo_stream_late_rows_total", "counter",
+            "Ingested rows that arrived behind the event-time watermark",
+            {}, totals["late_rows_total"],
+        )
+        yield (
+            "gordo_stream_dropped_rows_total", "counter",
+            "Late rows beyond GORDO_STREAM_LATENESS_S, dropped", {},
+            totals["dropped_rows_total"],
+        )
+        yield (
+            "gordo_stream_members", "gauge",
+            "Members with live window buffers", {}, totals["buffers"],
+        )
+        now = time.time()
+        lag = self.ingestor.max_watermark_lag_s(now)
+        if lag is not None:
+            yield (
+                "gordo_stream_watermark_lag_seconds", "gauge",
+                "Worst wall-vs-event-time lag across window buffers", {},
+                lag,
+            )
+        stale = self.ingestor.max_staleness_s(now)
+        if stale is not None:
+            yield (
+                "gordo_model_staleness_seconds", "gauge",
+                "Seconds since fresh stream rows last arrived (worst "
+                "member)", {}, stale,
+            )
+        for name, st in sorted(self.detector.members.items()):
+            if st.drift_score is not None:
+                yield (
+                    "gordo_drift_score", "gauge",
+                    "EWMA scaled reconstruction error / train-time "
+                    "threshold (>1 = drifted)", {"model": name},
+                    st.drift_score,
+                )
+        yield (
+            "gordo_drift_members", "gauge",
+            "Members currently flagged as drifted", {},
+            len(self.detector.drifted_members()),
+        )
+        yield (
+            "gordo_stream_adaptations_total", "counter",
+            "Applied adaptations (recalibrations or refits that swapped "
+            "a new generation in)", {}, self.stats["adaptations"],
+        )
+        yield (
+            "gordo_stream_recalibrated_members_total", "counter",
+            "Members whose thresholds were recalibrated", {},
+            self.stats["recalibrated_members"],
+        )
+        yield (
+            "gordo_stream_refit_members_total", "counter",
+            "Members incrementally refit", {}, self.stats["refit_members"],
+        )
+        yield (
+            "gordo_stream_refit_failed_total", "counter",
+            "Refit/recalibration attempts that failed and rolled back",
+            {}, self.stats["refit_failed"],
+        )
+
+    # ---------------------------- ingestion ---------------------------- #
+
+    def ingest(self, name: str, event_ts, values) -> Dict[str, Any]:
+        return self.ingestor.ingest(name, event_ts, values)
+
+    # ------------------------- drift evaluation ------------------------ #
+
+    async def evaluate(self) -> Dict[str, Any]:
+        """Run one drift sweep off the event loop."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.detector.evaluate)
+
+    def drift_view(self) -> Dict[str, Any]:
+        body = self.detector.view()
+        body["auto"] = self.auto
+        body["interval_s"] = self.interval_s
+        body["refit_threshold"] = self.refit_threshold
+        body["stats"] = dict(self.stats)
+        return body
+
+    # --------------------------- adaptation ---------------------------- #
+
+    def _lock(self) -> asyncio.Lock:
+        # the reload lock (server/utils.py): every path that rebuilds
+        # the bank — /reload, rebalance, adaptation — serializes here
+        from gordo_components_tpu.server.utils import get_reload_lock
+
+        return get_reload_lock(self.app)
+
+    async def adapt(
+        self, mode: str = "recalibrate", targets: Optional[List[str]] = None
+    ) -> Dict[str, Any]:
+        """Recalibrate (or refit) ``targets`` (default: the currently
+        drifted members) and apply the result as a new bank generation.
+        Failures leave the serving generation untouched and re-raise."""
+        if mode not in ("recalibrate", "refit"):
+            raise ValueError(f"mode must be recalibrate|refit, got {mode!r}")
+        app = self.app
+        loop = asyncio.get_running_loop()
+        async with self._lock():
+            names = (
+                list(targets) if targets else self.detector.drifted_members()
+            )
+            if not names:
+                return {"applied": False, "reason": "no drifted members", "mode": mode}
+            collection = app["collection"]
+            prev_state = collection.snapshot()
+            registry = app.get("metrics")
+            worker = (
+                self._refit_sync if mode == "refit" else self._recalibrate_sync
+            )
+            try:
+                updates = await loop.run_in_executor(
+                    None, functools.partial(worker, names)
+                )
+            except Exception as exc:
+                self.stats["refit_failed"] += 1
+                self.stats["last_error"] = f"{type(exc).__name__}: {exc}"
+                raise
+            if not updates:
+                return {
+                    "applied": False, "mode": mode,
+                    "reason": "no member had a usable fresh window",
+                }
+            swap_info = None
+            collection.publish(
+                updates,
+                note={"adapted": mode, "at": time.time()},
+            )
+            if app.get("bank_enabled"):
+                from gordo_components_tpu.placement.swap import (
+                    _restore_collectors,
+                    build_bank,
+                    snapshot_collectors,
+                    swap_bank,
+                )
+
+                prev_collectors = snapshot_collectors(registry)
+                try:
+                    bank = await loop.run_in_executor(
+                        None,
+                        functools.partial(build_bank, app, collection.models),
+                    )
+                    result = swap_bank(
+                        app, bank, prev_collectors=prev_collectors
+                    )
+                except Exception as exc:
+                    # full rollback: the published models AND the
+                    # registry's bank collectors return to the serving
+                    # generation's state — an adaptation that cannot
+                    # land must be invisible
+                    collection.restore(prev_state)
+                    _restore_collectors(registry, prev_collectors)
+                    self.stats["refit_failed"] += 1
+                    self.stats["last_error"] = f"{type(exc).__name__}: {exc}"
+                    raise
+                controller = app.get("placement")
+                if controller is not None:
+                    controller.record_swap(result)
+                swap_info = {
+                    "generation": result.generation,
+                    "pause_ms": round(result.pause_s * 1e3, 3),
+                    "build_s": round(result.build_s, 3),
+                }
+                self.stats["last_generation"] = result.generation
+            self.stats["adaptations"] += 1
+            self.stats["last_mode"] = mode
+            self.stats["last_error"] = None
+            key = "refit_members" if mode == "refit" else "recalibrated_members"
+            self.stats[key] += len(updates)
+            # the adapted members' EWMA was measured under the OLD
+            # calibration — carrying it forward would keep them flagged
+            # (and the auto loop re-adapting) for several intervals
+            # after the fix already landed. Reset so the next sweep
+            # measures fresh against the new thresholds.
+            for name in updates:
+                st = self.detector.members.get(name)
+                if st is not None:
+                    st.ewma_total = None
+                    st.drift_score = None
+                    st.drifted = False
+            body: Dict[str, Any] = {
+                "applied": True,
+                "mode": mode,
+                "members": sorted(updates),
+            }
+            if swap_info is not None:
+                body["swap"] = swap_info
+            return body
+
+    # ------------------- recalibration (no retrain) -------------------- #
+
+    def _recalibrate_sync(self, names: List[str]) -> Dict[str, Any]:
+        """Blocking: per member, re-fit the error scaler on the fresh
+        window, EWMA-blend with the serving scaler, re-derive thresholds
+        at the member's quantile. Returns name -> replacement detector.
+        Per-member isolated: one member's failure skips it (logged), it
+        never aborts the batch."""
+        collection = self.app["collection"]
+        models = collection.models
+        a = self.recal_alpha
+        updates: Dict[str, Any] = {}
+        for name in names:
+            try:
+                new_det = self._recalibrate_one(models, name, a)
+            except Exception:
+                # per-member isolation (the drift sweep's contract): one
+                # member's short window / scoring failure must not abort
+                # — or roll back — every OTHER member's recalibration,
+                # and must not wedge the auto loop forever
+                logger.warning(
+                    "recalibration failed for %r; other members proceed",
+                    name, exc_info=True,
+                )
+                continue
+            if new_det is not None:
+                updates[name] = new_det
+        return updates
+
+    def _recalibrate_one(self, models, name: str, a: float):
+        from gordo_components_tpu.ops.scaler import ScalerParams
+
+        det = models.get(name)
+        buf = self.ingestor.buffers.get(name)
+        if det is None or buf is None:
+            return None
+        _ts, X = buf.clean_window()
+        # sequence members consume lookback+offset warm-up rows before
+        # the first scored row exists — same floor the refit path applies
+        if len(X) < max(self.detector.min_rows, det._offset + 8):
+            return None
+        old = getattr(det, "error_scaler_", None)
+        if old is None:
+            return None
+        Xv = np.asarray(X, np.float32)
+        output = det._predict_model_space(Xv)
+        target = det._model_space(Xv)
+        target = target[det._offset:][: output.shape[0]]
+        diff = np.abs(target - output)
+        # window min-max in error space, blended with the serving
+        # scaler in (shift, range) form — blending the reciprocal
+        # scale directly would bias toward the tighter range
+        w_min = np.nanmin(diff, axis=0)
+        w_max = np.nanmax(diff, axis=0)
+        w_range = np.where(np.abs(w_max - w_min) < 1e-12, 1.0, w_max - w_min)
+        old_shift = np.asarray(old.shift, np.float32)
+        old_range = np.where(
+            np.asarray(old.scale) == 0, 1.0, 1.0 / np.asarray(old.scale)
+        )
+        shift = ((1 - a) * old_shift + a * w_min).astype(np.float32)
+        rng_ = ((1 - a) * old_range + a * w_range).astype(np.float32)
+        scaler = ScalerParams(shift=shift, scale=(1.0 / rng_).astype(np.float32))
+        scaled = (diff - shift) * scaler.scale
+        q = float(getattr(det, "threshold_quantile", 1.0))
+        new_det = copy.copy(det)  # weights shared; calibration replaced
+        new_det.error_scaler_ = scaler
+        new_det.feature_thresholds_ = np.quantile(scaled, q, axis=0)
+        new_det.total_threshold_ = float(
+            np.quantile(np.linalg.norm(scaled, axis=-1), q)
+        )
+        new_det.threshold_method_ = "recalibrated-ewma"
+        return new_det
+
+    # --------------------- incremental refit (gang) -------------------- #
+
+    def _refit_sync(self, names: List[str]) -> Dict[str, Any]:
+        """Blocking: fine-tune the named members for a few epochs via
+        ``FleetTrainer`` on their fresh windows, warm-started from the
+        serving weights. Members group by architecture (one gang per
+        (model_type, kind, factory kwargs, lookback) signature)."""
+        _FP_REFIT.fire()
+        import pandas as pd
+
+        from gordo_components_tpu.parallel.fleet import FleetTrainer
+
+        collection = self.app["collection"]
+        models = collection.models
+        groups: Dict[str, Dict[str, Any]] = {}
+        for name in names:
+            det = models.get(name)
+            buf = self.ingestor.buffers.get(name)
+            if det is None or buf is None:
+                continue
+            est = det._final_estimator
+            params = getattr(est, "params_", None)
+            if params is None:
+                continue
+            _ts, X = buf.clean_window()
+            lookback = int(getattr(est, "lookback_window", 1))
+            t_off = int(getattr(est, "_target_offset", 0))
+            if len(X) < max(self.detector.min_rows, lookback + t_off + 8):
+                continue
+            sig = repr(
+                (
+                    type(est).__name__, est.kind,
+                    sorted(est.factory_kwargs.items()), lookback, t_off,
+                    float(getattr(det, "threshold_quantile", 1.0)),
+                )
+            )
+            g = groups.setdefault(
+                sig, {"det": det, "est": est, "members": {}, "initial": {}}
+            )
+            tags = getattr(det, "tags_", None) or [
+                f"feature-{i}" for i in range(X.shape[1])
+            ]
+            g["members"][name] = pd.DataFrame(X, columns=tags)
+            g["initial"][name] = params
+        updates: Dict[str, Any] = {}
+        for g in groups.values():
+            det, est = g["det"], g["est"]
+            trainer = FleetTrainer(
+                model_type=type(est).__name__,
+                kind=est.kind,
+                epochs=max(1, self.refit_epochs),
+                batch_size=64,
+                lookback_window=int(getattr(est, "lookback_window", 1)),
+                threshold_quantile=float(getattr(det, "threshold_quantile", 1.0)),
+                compute_dtype=getattr(est, "compute_dtype", "float32"),
+                **est.factory_kwargs,
+            )
+            fleet = trainer.fit(g["members"], initial_params=g["initial"])
+            for name, member in fleet.items():
+                new_det = member.to_estimator()
+                new_det.threshold_method_ = "incremental-refit"
+                updates[name] = new_det
+        return updates
+
+    # -------------------------- the auto loop -------------------------- #
+
+    def start(self) -> None:
+        if self.auto and self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.evaluate()
+                drifted = self.detector.drifted_members()
+                if not drifted:
+                    continue
+                if self.refit_threshold > 0:
+                    hot = [
+                        n
+                        for n in drifted
+                        if (self.detector.members[n].drift_score or 0)
+                        >= self.refit_threshold
+                    ]
+                else:
+                    hot = []
+                await self.adapt("recalibrate", targets=drifted)
+                if hot:
+                    await self.adapt("refit", targets=hot)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the adapt() rollback contract already ran; the loop
+                # survives to try again next interval
+                logger.warning(
+                    "auto adaptation attempt failed; serving generation "
+                    "untouched", exc_info=True,
+                )

@@ -17,8 +17,26 @@ from gordo_components_tpu.utils.profiling import (
 
 __all__ = [
     "capture_args",
+    "env_num",
     "metadata_timestamp",
     "package_version",
     "device_memory_stats",
     "maybe_profile",
 ]
+
+
+def env_num(name: str, default, cast):
+    """Numeric env knob with an actionable error: these deploy to every
+    replica, and a bare ``int()``/``float()`` traceback would crashloop
+    the fleet with no hint which knob is malformed. Empty/unset keeps
+    the default. (Several older modules carry a private copy of this
+    predating the shared helper; new code should use this one.)"""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None

@@ -270,6 +270,18 @@ class WatchmanState:
         self._cache: Optional[Dict[str, Any]] = None
         self._cache_time = 0.0
         self._lock = asyncio.Lock()
+        # streaming drift rollup cache (fleet_drift): refreshed on the
+        # snapshot cadence; staleness above this folds into the health
+        # snapshot's degraded calculus (env GORDO_STALENESS_DEGRADED_S)
+        from gordo_components_tpu.utils import env_num
+
+        self.staleness_degraded_s = env_num(
+            "GORDO_STALENESS_DEGRADED_S", 600.0, float
+        )
+        self._drift_cache: Optional[Dict[str, Any]] = None
+        self._drift_time = 0.0
+        self._drift_lock = asyncio.Lock()
+        self._drift_task: Optional[asyncio.Task] = None
 
     def _url(self, target: str, endpoint: str) -> str:
         return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
@@ -486,6 +498,117 @@ class WatchmanState:
             for i, body in enumerate(bodies)
         ]
         return merged
+
+    async def fleet_drift(
+        self, refresh: bool = False, wait: bool = True
+    ) -> Optional[Dict[str, Any]]:
+        """Fleet drift rollup (streaming adaptation plane): fetch every
+        replica's ``GET /drift`` and aggregate — per replica the drifted
+        member list, the WORST-drift member attribution, and the max
+        staleness; fleet-wide the union of drifted members, the worst
+        (replica, member, score) triple, and the max
+        ``gordo_model_staleness_seconds``. Replicas with streaming
+        disabled (or unreachable) contribute nothing, never an error.
+
+        ``wait=False`` (the health-snapshot path) serves the cached
+        rollup and kicks a background refresh — one hung replica must
+        not add its scrape timeout to the ``/`` health endpoint.
+        ``refresh`` forwards ``?refresh=1`` so every replica runs a
+        fresh drift sweep first."""
+        if not wait:
+            if (
+                self._drift_cache is None
+                or time.monotonic() - self._drift_time >= self.refresh_interval
+            ) and (self._drift_task is None or self._drift_task.done()):
+                self._drift_task = asyncio.get_running_loop().create_task(
+                    self.fleet_drift()
+                )
+            return self._drift_cache
+        async with self._drift_lock:
+            now = time.monotonic()
+            if (
+                not refresh
+                and self._drift_cache is not None
+                and now - self._drift_time < self.refresh_interval
+            ):
+                return self._drift_cache
+            urls = [u + "/drift" for u in self._replica_prefixes()]
+            params = {"refresh": "1"} if refresh else None
+            timeout = aiohttp.ClientTimeout(total=30)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+
+                async def fetch(url):
+                    async def get():
+                        async with session.get(url, params=params) as resp:
+                            if resp.status != 200:
+                                return None
+                            return await resp.json()
+
+                    try:
+                        return await Deadline(10.0).wait_for(get())
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:
+                        logger.debug("drift scrape failed for %s: %s", url, exc)
+                        return None
+
+                bodies = list(await asyncio.gather(*(fetch(u) for u in urls)))
+            replicas: List[Dict[str, Any]] = []
+            drifted_union: List[str] = []
+            worst: Optional[Dict[str, Any]] = None
+            max_stale: Optional[float] = None
+            for i, body in enumerate(bodies):
+                entry: Dict[str, Any] = {
+                    "replica": i,
+                    "scraped": body is not None,
+                    "stream_enabled": bool(body and body.get("enabled")),
+                }
+                if body and body.get("enabled"):
+                    drifted = body.get("drifted") or []
+                    entry["drifted"] = drifted
+                    drifted_union.extend(drifted)
+                    members = body.get("members") or {}
+                    r_worst, r_stale = None, None
+                    for name, m in members.items():
+                        score = m.get("drift_score")
+                        if score is not None and (
+                            r_worst is None or score > r_worst["drift_score"]
+                        ):
+                            r_worst = {"model": name, "drift_score": score}
+                        stale = m.get("staleness_seconds")
+                        if stale is not None and (
+                            r_stale is None or stale > r_stale
+                        ):
+                            r_stale = stale
+                    entry["worst"] = r_worst
+                    entry["max_staleness_seconds"] = r_stale
+                    if r_worst is not None and (
+                        worst is None
+                        or r_worst["drift_score"] > worst["drift_score"]
+                    ):
+                        worst = {"replica": i, **r_worst}
+                    if r_stale is not None and (
+                        max_stale is None or r_stale > max_stale
+                    ):
+                        max_stale = r_stale
+                replicas.append(entry)
+            rollup = {
+                "replicas": replicas,
+                "replicas_streaming": sum(
+                    1 for r in replicas if r["stream_enabled"]
+                ),
+                "drifted": sorted(set(drifted_union)),
+                "worst": worst,
+                "max_staleness_seconds": max_stale,
+                "staleness_degraded_s": self.staleness_degraded_s,
+                "stale_degraded": bool(
+                    max_stale is not None
+                    and max_stale > self.staleness_degraded_s
+                ),
+            }
+            self._drift_cache = rollup
+            self._drift_time = time.monotonic()
+            return rollup
 
     async def fleet_rebalance(
         self, dry_run: bool = False, force: bool = False
@@ -869,6 +992,28 @@ def build_watchman_app(
                     if ts is not None
                 },
             }
+        # streaming drift/staleness, folded into the health snapshot's
+        # degraded calculus: a fleet whose freshest data is older than
+        # GORDO_STALENESS_DEGRADED_S (or with members drifted past their
+        # thresholds) is serving answers nobody should trust — mark the
+        # snapshot degraded with the reason, the same
+        # 200-with-status-body contract the server's /healthz uses.
+        # wait=False: the health path never blocks on a drift scrape
+        drift = await state.fleet_drift(wait=False)
+        if drift is not None and drift["replicas_streaming"]:
+            body["streaming"] = {
+                "drifted": drift["drifted"],
+                "worst": drift["worst"],
+                "max_staleness_seconds": drift["max_staleness_seconds"],
+                "stale_degraded": drift["stale_degraded"],
+            }
+            if drift["stale_degraded"] or drift["drifted"]:
+                body["status"] = "degraded"
+                body["degraded_reason"] = (
+                    "model staleness above GORDO_STALENESS_DEGRADED_S"
+                    if drift["stale_degraded"]
+                    else f"{len(drift['drifted'])} member(s) drifted"
+                )
         return web.json_response(body)
 
     async def healthcheck(request: web.Request) -> web.Response:
@@ -919,6 +1064,17 @@ def build_watchman_app(
         )
         return web.json_response(await state.fleet_slo(refresh=refresh))
 
+    async def drift(request: web.Request) -> web.Response:
+        """Fleet drift rollup: every replica's ``GET /drift`` aggregated
+        — drifted members, worst-drift attribution per replica, and the
+        fleet's max data staleness. ``?refresh=1`` forces a fresh drift
+        sweep on every replica first."""
+        refresh = request.query.get("refresh", "").lower() in (
+            "1", "true", "yes",
+        )
+        rollup = await state.fleet_drift(refresh=refresh)
+        return web.json_response(rollup)
+
     async def rebalance(request: web.Request) -> web.Response:
         """Fleet rebalance fan-out: forward ``POST /rebalance`` to every
         replica (``?dry_run=1`` previews; JSON body ``{"force": true}``
@@ -943,6 +1099,7 @@ def build_watchman_app(
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
     app.router.add_get("/slo", slo)
+    app.router.add_get("/drift", drift)
     app.router.add_post("/rebalance", rebalance)
     return app
 

@@ -39,6 +39,8 @@ EXPECTED_SITES = {
     "engine.queue",
     "fleet_build.group",
     "model_io.load",
+    "stream.ingest",  # driven in tests/test_streaming.py (chaos mark)
+    "stream.refit",  # driven in tests/test_streaming.py (chaos mark)
     "watchman.scrape",
     "watchman.snapshot",
 }
@@ -129,6 +131,7 @@ def test_every_failure_site_is_registered():
     import gordo_components_tpu.placement.swap  # noqa: F401
     import gordo_components_tpu.server.bank  # noqa: F401
     import gordo_components_tpu.server.model_io  # noqa: F401
+    import gordo_components_tpu.streaming  # noqa: F401
     import gordo_components_tpu.watchman.server  # noqa: F401
 
     assert EXPECTED_SITES <= set(resilience.registered_sites())

@@ -1,0 +1,695 @@
+"""Streaming ingestion & online adaptation plane (ISSUE 9).
+
+Covers the window buffers (ring bounds, event-time watermark, late/
+out-of-order accounting, dropout masking), the drift-injectable
+simulated live provider, drift detection flagging EXACTLY the shifted
+members, the end-to-end acceptance (mean-shift drift on K members of a
+heterogeneous multi-bucket fleet under concurrent scoring load ->
+recalibration + incremental refit land as new bank generations through
+the zero-downtime swap with zero non-200s and a measurable
+false-positive-rate drop), the ``stream.ingest``/``stream.refit`` chaos
+rollbacks through the public HTTP API, the client's streaming
+forwarder, watchman's fleet drift rollup, the FleetTrainer warm start,
+and the GORDO_STREAM=0 default-off contract (<=5% hot-loop guard + no
+streaming series). Lane: ``make stream`` (marker ``stream``)."""
+
+import asyncio
+import contextlib
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gordo_components_tpu import serializer
+from gordo_components_tpu.dataset.data_provider.streaming import (
+    SimulatedLiveProvider,
+)
+from gordo_components_tpu.models import AutoEncoder, DiffBasedAnomalyDetector
+from gordo_components_tpu.resilience import faults
+from gordo_components_tpu.server import build_app
+from gordo_components_tpu.server.bank import ModelBank
+from gordo_components_tpu.streaming.ingest import StreamIngestor, WindowBuffer
+
+pytestmark = pytest.mark.stream
+
+TAGS3 = [f"tag-{i}" for i in range(3)]
+TAGS5 = [f"tag-{i}" for i in range(5)]
+MEMBERS = {  # heterogeneous: two feature counts -> two bank buckets
+    "m3-0": TAGS3, "m3-1": TAGS3, "m3-2": TAGS3, "m3-3": TAGS3,
+    "m5-0": TAGS5, "m5-1": TAGS5,
+}
+SHIFTED = ("m3-1", "m5-0")  # K=2 drifted members, one per bucket
+T_TRAIN = pd.Timestamp("2026-08-01T00:00:00Z")
+T_LIVE = pd.Timestamp("2026-08-02T00:00:00Z")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _provider():
+    return SimulatedLiveProvider(freq="10s", noise=0.1, seed=5)
+
+
+@pytest.fixture(scope="module")
+def stream_root(tmp_path_factory):
+    """Artifacts trained on the SAME generator the live stream uses, so
+    healthy streamed data matches the training distribution."""
+    prov = _provider()
+    root = tmp_path_factory.mktemp("stream-fleet")
+    for name, tags in MEMBERS.items():
+        frame = prov.frame(T_TRAIN, 240, tags)
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(epochs=3, batch_size=64)
+        )
+        det.fit(frame)
+        serializer.dump(det, str(root / name), metadata={"name": name})
+    return root
+
+
+class _Stamper:
+    """Re-anchors synthetic event times to the wall clock, each batch
+    continuing where the previous one ended — a live stream catching up
+    to now, not replaying one window forever."""
+
+    def __init__(self, back_s: float = 3600.0):
+        self.cursor = time.time() - back_s
+
+    def __call__(self, ts: np.ndarray) -> list:
+        out = (np.asarray(ts) - ts[0] + self.cursor).tolist()
+        self.cursor = out[-1] + 10.0
+        return out
+
+
+def _rows(vals: np.ndarray) -> list:
+    return [
+        [None if v != v else float(v) for v in row] for row in vals.tolist()
+    ]
+
+
+@contextlib.asynccontextmanager
+async def _stream_client(root, monkeypatch, **env):
+    monkeypatch.setenv("GORDO_STREAM", "1")
+    monkeypatch.setenv("GORDO_SERVER_WARMUP", "0")
+    monkeypatch.setenv("GORDO_STREAM_WINDOW", "128")
+    monkeypatch.setenv("GORDO_STREAM_MIN_ROWS", "32")
+    monkeypatch.setenv("GORDO_REFIT_EPOCHS", "2")
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    client = TestClient(TestServer(build_app(str(root), devices=1)))
+    await client.start_server()
+    try:
+        yield client
+    finally:
+        await client.close()
+
+
+async def _ingest(client, name, ts, vals, stamp):
+    resp = await client.post(
+        f"/gordo/v0/p/{name}/ingest",
+        json={"rows": _rows(vals), "timestamps": stamp(ts)},
+    )
+    body = await resp.json()
+    assert resp.status == 200, body
+    return body
+
+
+# ------------------------------------------------------------------ #
+# window buffer
+# ------------------------------------------------------------------ #
+
+
+def test_window_buffer_ring_watermark_and_accounting():
+    buf = WindowBuffer(capacity=8, n_features=2, lateness_s=10.0)
+    out = buf.add(np.arange(5.0) + 100, np.ones((5, 2), np.float32))
+    assert out == {"accepted": 5, "late": 0, "dropped": 0}
+    assert buf.watermark == 104.0 and len(buf) == 5
+    # out-of-order within the allowance: accepted, counted late
+    out = buf.add(np.array([101.5]), np.full((1, 2), 7.0, np.float32))
+    assert out == {"accepted": 1, "late": 1, "dropped": 0}
+    # beyond the allowance: counted AND dropped
+    out = buf.add(np.array([50.0]), np.zeros((1, 2), np.float32))
+    assert out == {"accepted": 0, "late": 1, "dropped": 1}
+    assert buf.late_rows == 2 and buf.dropped_rows == 1
+    # ring wraps: only the freshest `capacity` rows remain, time-ordered,
+    # and the overflow is accounted as dropped — every posted row lands
+    # in exactly one counter (accepted + dropped == rows posted)
+    out = buf.add(np.arange(10.0) + 110, np.zeros((10, 2), np.float32))
+    assert out == {"accepted": 8, "late": 0, "dropped": 2}
+    ts, vals = buf.window()
+    assert len(ts) == 8 and (np.diff(ts) >= 0).all()
+    assert ts[-1] == 119.0
+    assert buf.rows_total == 5 + 1 + 8
+
+
+def test_window_buffer_dropout_masking():
+    buf = WindowBuffer(capacity=16, n_features=2, lateness_s=60.0)
+    vals = np.ones((4, 2), np.float32)
+    vals[1, 0] = np.nan
+    vals[3, 1] = np.nan
+    buf.add(np.arange(4.0), vals)
+    assert buf.dropout_cells == 2
+    ts, clean = buf.clean_window()
+    assert clean.shape == (2, 2)  # any-NaN rows excluded
+    assert np.isfinite(clean).all()
+
+
+def test_window_buffer_shape_validation():
+    buf = WindowBuffer(capacity=4, n_features=3, lateness_s=1.0)
+    with pytest.raises(ValueError, match="rows, 3"):
+        buf.add(np.arange(2.0), np.ones((2, 2), np.float32))
+    with pytest.raises(ValueError, match="timestamps for"):
+        buf.add(np.arange(3.0), np.ones((2, 3), np.float32))
+    # a NaN event time would poison the watermark forever (every
+    # comparison against NaN is False): rejected, nothing mutated
+    with pytest.raises(ValueError, match="finite"):
+        buf.add(np.array([1.0, np.nan]), np.ones((2, 3), np.float32))
+    assert buf.watermark is None and len(buf) == 0
+
+
+def test_ingestor_staleness_and_watermark_lag():
+    ing = StreamIngestor(capacity=8, lateness_s=60.0)
+    now = time.time()
+    ing.ingest("a", np.array([now - 30.0]), np.ones((1, 2), np.float32))
+    ing.ingest("b", np.array([now - 5.0]), np.ones((1, 2), np.float32))
+    lag = ing.max_watermark_lag_s(now)
+    assert lag is not None and 29.0 <= lag <= 31.0  # worst buffer
+    stale = ing.max_staleness_s()
+    assert stale is not None and stale < 5.0  # rows ARRIVED just now
+    totals = ing.totals()
+    assert totals["rows_total"] == 2 and totals["buffers"] == 2
+
+
+# ------------------------------------------------------------------ #
+# simulated live provider
+# ------------------------------------------------------------------ #
+
+
+def test_provider_deterministic_and_drift_injectable():
+    a, b = _provider(), _provider()
+    ts1, v1 = a.batch(T_LIVE, 64, TAGS3)
+    ts2, v2 = b.batch(T_LIVE, 64, TAGS3)
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(ts1, ts2)
+    # mean shift on selected tags only
+    a.inject(mean_shift=2.0, tags=[TAGS3[0]])
+    _, v3 = a.batch(T_LIVE, 64, TAGS3)
+    assert np.nanmean(v3[:, 0]) - np.nanmean(v1[:, 0]) > 1.5
+    np.testing.assert_allclose(v3[:, 1:], v1[:, 1:])
+    # dropout + late delivery
+    a.inject(dropout_p=0.2, late_fraction=0.25)
+    ts4, v4 = a.batch(T_LIVE, 64, TAGS3)
+    assert np.isnan(v4).sum() > 0
+    assert (np.diff(ts4) < 0).any()  # out-of-order arrival
+    np.testing.assert_array_equal(np.sort(ts4), ts1)  # same event times
+    # variance inflation
+    a.inject(var_inflation=9.0)
+    _, v5 = a.batch(T_LIVE, 64, TAGS3)
+    assert np.nanstd(v5) > 2.0 * np.nanstd(v1)
+    # the training-side view (load_series) stays healthy under injection:
+    # drift is a property of the live stream, never of the training range
+    from gordo_components_tpu.dataset.sensor_tag import normalize_sensor_tags
+
+    series = list(
+        a.load_series(
+            T_LIVE, T_LIVE + pd.Timedelta("640s"),
+            normalize_sensor_tags(TAGS3),
+        )
+    )
+    np.testing.assert_allclose(
+        np.stack([s.values[:64] for s in series], axis=1), v1, rtol=1e-6
+    )
+
+
+# ------------------------------------------------------------------ #
+# HTTP surface: ingest / drift / default-off
+# ------------------------------------------------------------------ #
+
+
+async def test_ingest_endpoint_and_stream_metrics(stream_root, monkeypatch):
+    async with _stream_client(stream_root, monkeypatch) as client:
+        prov, stamp = _provider(), _Stamper()
+        ts, vals = prov.batch(T_LIVE, 48, TAGS3)
+        body = await _ingest(client, "m3-0", ts, vals, stamp)
+        assert body["accepted"] == 48 and body["window_rows"] == 48
+        # a replayed old batch is late beyond the allowance: dropped
+        resp = await client.post(
+            "/gordo/v0/p/m3-0/ingest",
+            json={
+                "rows": _rows(vals),
+                "timestamps": (np.asarray(stamp(ts)) - 36000).tolist(),
+            },
+        )
+        late = await resp.json()
+        assert late["accepted"] == 0 and late["dropped"] == 48
+        # unknown target 404s like the scoring endpoints
+        resp = await client.post(
+            "/gordo/v0/p/no-such/ingest", json={"rows": [[1, 2, 3]]}
+        )
+        assert resp.status == 404
+        # malformed bodies 400 with a reason (never a 500: bad client
+        # input must not burn the availability/goodput accounting)
+        for bad in (
+            {},
+            {"rows": []},
+            {"rows": [[1, 2]], "timestamps": [1, 2]},
+            {"rows": [[1.0, 2.0, 3.0]], "timestamps": 5},
+            {"rows": [[1.0, 2.0, 3.0]], "timestamps": [None]},
+        ):
+            resp = await client.post("/gordo/v0/p/m3-0/ingest", json=bad)
+            assert resp.status == 400, (bad, await resp.text())
+        # the stability-contract series render with the ingested counts
+        text = await (await client.get("/gordo/v0/p/metrics")).text()
+        assert "gordo_stream_rows_total 48" in text
+        assert "gordo_stream_late_rows_total 48" in text
+        assert "gordo_stream_dropped_rows_total 48" in text
+        assert "gordo_stream_watermark_lag_seconds" in text
+        assert "gordo_model_staleness_seconds" in text
+        # /drift reports the same accounting (no-drift contract)
+        drift = await (await client.get("/gordo/v0/p/drift")).json()
+        assert drift["enabled"] and drift["rows_total"] == 48
+        assert drift["members"]["m3-0"]["late_rows"] == 48
+        assert drift["members"]["m3-0"]["staleness_seconds"] is not None
+
+
+async def test_stream_disabled_is_default_off(stream_root):
+    """The default-off contract: no plane, 404s naming the knob, and not
+    one streaming series in the exposition."""
+    assert os.environ.get("GORDO_STREAM", "0") in ("0", "", None)
+    client = TestClient(TestServer(build_app(str(stream_root), devices=1)))
+    await client.start_server()
+    try:
+        assert client.server.app.get("stream") is None
+        resp = await client.post(
+            "/gordo/v0/p/m3-0/ingest", json={"rows": [[1.0, 2.0, 3.0]]}
+        )
+        assert resp.status == 404
+        assert "GORDO_STREAM" in (await resp.json())["error"]
+        resp = await client.post("/gordo/v0/p/adapt", json={})
+        assert resp.status == 404
+        drift = await (await client.get("/gordo/v0/p/drift")).json()
+        assert drift == {"enabled": False}
+        text = await (await client.get("/gordo/v0/p/metrics")).text()
+        assert "gordo_stream" not in text
+        assert "gordo_drift" not in text
+        assert "gordo_model_staleness" not in text
+    finally:
+        await client.close()
+
+
+# ------------------------------------------------------------------ #
+# E2E acceptance: drift -> detect -> recalibrate + refit -> swap
+# ------------------------------------------------------------------ #
+
+
+async def _fp_rate(client, name, X, threshold) -> float:
+    resp = await client.post(
+        f"/gordo/v0/p/{name}/anomaly/prediction", json={"X": X.tolist()}
+    )
+    body = await resp.json()
+    assert resp.status == 200, body
+    totals = np.asarray(body["data"]["total-anomaly-scaled"])
+    return float((totals > threshold).mean())
+
+
+async def test_acceptance_drift_recalibrate_refit_no_5xx(
+    stream_root, monkeypatch
+):
+    """The ISSUE 9 acceptance: mean-shift drift on K=2 members of a
+    heterogeneous two-bucket fleet under concurrent scoring load ->
+    ``gordo_drift_score`` flags exactly those members, recalibration
+    (and an incremental refit for one of them) land as new bank
+    generations via the hot-swap with ZERO non-200 responses, and the
+    post-swap false-positive anomaly rate on shifted-but-healthy data
+    measurably drops vs pre-swap."""
+    async with _stream_client(stream_root, monkeypatch) as client:
+        app = client.server.app
+        prov, stamp = _provider(), _Stamper()
+        # phase 0: healthy windows for everyone -> nothing drifts
+        for name, tags in MEMBERS.items():
+            ts, vals = prov.batch(T_LIVE, 96, tags)
+            await _ingest(client, name, ts, vals, stamp)
+        drift = await (
+            await client.get("/gordo/v0/p/drift?refresh=1")
+        ).json()
+        assert drift["drifted"] == []
+        # phase 1: shifted-but-healthy data floods K members' windows
+        prov.inject(mean_shift=4.0)
+        shifted = {}
+        for name in SHIFTED:
+            tags = MEMBERS[name]
+            for k in range(2):  # 192 rows displace the healthy 128-ring
+                ts, vals = prov.batch(
+                    T_LIVE + pd.Timedelta(f"{k + 1}h"), 96, tags
+                )
+                await _ingest(client, name, ts, vals, stamp)
+            shifted[name] = vals
+        drift = await (
+            await client.get("/gordo/v0/p/drift?refresh=1")
+        ).json()
+        assert drift["drifted"] == sorted(SHIFTED), drift["drifted"]
+        # ...and the gauges agree (exactly the shifted members above 1.0)
+        text = await (await client.get("/gordo/v0/p/metrics")).text()
+        flagged = set()
+        for line in text.splitlines():
+            if line.startswith("gordo_drift_score{"):
+                name = line.split('model="')[1].split('"')[0]
+                if float(line.rsplit(" ", 1)[1]) > 1.0:
+                    flagged.add(name)
+        assert flagged == set(SHIFTED)
+
+        # pre-swap FP rate on shifted-but-healthy data vs serving thresholds
+        collection = app["collection"]
+        fp_pre = {}
+        for name in SHIFTED:
+            fp_pre[name] = await _fp_rate(
+                client, name, shifted[name],
+                collection.models[name].total_threshold_,
+            )
+        assert min(fp_pre.values()) > 0.3, fp_pre
+
+        # concurrent scoring load across BOTH buckets while adapting
+        statuses: list = []
+        stop = asyncio.Event()
+
+        async def load():
+            i = 0
+            names = list(MEMBERS)
+            while not stop.is_set():
+                name = names[i % len(names)]
+                i += 1
+                X = [[0.1] * len(MEMBERS[name])] * 16
+                resp = await client.post(
+                    f"/gordo/v0/p/{name}/anomaly/prediction",
+                    json={"X": X},
+                    headers={"X-Gordo-Deadline-Ms": "30000"},
+                )
+                statuses.append(resp.status)
+                await resp.release()
+
+        loaders = [asyncio.create_task(load()) for _ in range(4)]
+        try:
+            resp = await client.post("/gordo/v0/p/adapt", json={})
+            recal = await resp.json()
+            assert resp.status == 200 and recal["applied"], recal
+            assert sorted(recal["members"]) == sorted(SHIFTED)
+            assert recal["swap"]["generation"] == 1
+            resp = await client.post(
+                "/gordo/v0/p/adapt",
+                json={"mode": "refit", "targets": [SHIFTED[0]]},
+            )
+            refit = await resp.json()
+            assert resp.status == 200 and refit["applied"], refit
+            assert refit["swap"]["generation"] == 2
+            await asyncio.sleep(0.2)  # load observes the new generations
+        finally:
+            stop.set()
+            await asyncio.gather(*loaders, return_exceptions=True)
+        assert statuses and set(statuses) == {200}, set(statuses)
+
+        # post-swap: recalibrated thresholds absorb the shifted-but-
+        # healthy distribution — the false-positive rate drops
+        fp_post = {}
+        for name in SHIFTED:
+            fp_post[name] = await _fp_rate(
+                client, name, shifted[name],
+                collection.models[name].total_threshold_,
+            )
+        for name in SHIFTED:
+            assert fp_post[name] < 0.5 * fp_pre[name], (fp_pre, fp_post)
+        # the refit member is a genuinely new model, provenance recorded
+        det = collection.models[SHIFTED[0]]
+        assert det.threshold_method_ == "incremental-refit"
+        meta = collection.metadata[SHIFTED[0]]["online-adaptation"]
+        assert meta["adapted"] == "refit"
+        # generation gauge + adaptation counters made it to the contract
+        text = await (await client.get("/gordo/v0/p/metrics")).text()
+        assert "gordo_bank_generation 2" in text
+        assert "gordo_stream_adaptations_total 2" in text
+        assert "gordo_stream_refit_members_total 1" in text
+
+
+# ------------------------------------------------------------------ #
+# chaos: stream.ingest / stream.refit through the public API
+# ------------------------------------------------------------------ #
+
+
+def _counters(snapshot):
+    out = {}
+    for name, fam in snapshot.items():
+        if fam.get("type") != "counter":
+            continue
+        for v in fam.get("values", []):
+            out[(name, tuple(sorted(v["labels"].items())))] = v["value"]
+    return out
+
+
+@pytest.mark.chaos
+async def test_chaos_stream_ingest_fault_500_counters_monotonic(
+    stream_root, monkeypatch
+):
+    async with _stream_client(stream_root, monkeypatch) as client:
+        app = client.server.app
+        prov, stamp = _provider(), _Stamper()
+        ts, vals = prov.batch(T_LIVE, 48, TAGS3)
+        await _ingest(client, "m3-0", ts, vals, stamp)
+        before = _counters(app["metrics"].snapshot())
+        faults.arm("stream.ingest", faults.FaultSpec(times=1))
+        resp = await client.post(
+            "/gordo/v0/p/m3-0/ingest",
+            json={"rows": _rows(vals), "timestamps": stamp(ts)},
+        )
+        assert resp.status == 500
+        assert resp.headers.get("X-Request-Id")  # stays traceable
+        after = _counters(app["metrics"].snapshot())
+        for key, val in before.items():
+            assert after.get(key, val) >= val, key
+        # the failed ingest added no rows; the next one works untouched
+        assert after[("gordo_stream_rows_total", ())] == 48
+        body = await _ingest(client, "m3-0", ts, vals, stamp)
+        assert body["accepted"] == 48
+        # scoring was never impaired
+        resp = await client.post(
+            "/gordo/v0/p/m3-0/anomaly/prediction",
+            json={"X": [[0.1, 0.2, 0.3]] * 8},
+        )
+        assert resp.status == 200
+
+
+@pytest.mark.chaos
+async def test_chaos_stream_refit_fault_leaves_generation_untouched(
+    stream_root, monkeypatch
+):
+    """An armed ``stream.refit`` fails the adaptation BEFORE any model
+    is touched: 500 with ``rolled_back``, the serving generation and the
+    published models are unchanged, counters stay monotonic, and the
+    next (unfaulted) attempt applies."""
+    async with _stream_client(stream_root, monkeypatch) as client:
+        app = client.server.app
+        prov, stamp = _provider(), _Stamper()
+        prov.inject(mean_shift=4.0)
+        for k in range(2):
+            ts, vals = prov.batch(T_LIVE + pd.Timedelta(f"{k}h"), 96, TAGS3)
+            await _ingest(client, "m3-1", ts, vals, stamp)
+        await client.get("/gordo/v0/p/drift?refresh=1")
+        det_before = app["collection"].models["m3-1"]
+        before = _counters(app["metrics"].snapshot())
+        faults.arm("stream.refit", faults.FaultSpec(times=1))
+        resp = await client.post(
+            "/gordo/v0/p/adapt", json={"mode": "refit", "targets": ["m3-1"]}
+        )
+        body = await resp.json()
+        assert resp.status == 500 and body["rolled_back"], body
+        assert body["generation"] == 0
+        assert app.get("bank_generation", 0) == 0
+        assert app["collection"].models["m3-1"] is det_before
+        after = _counters(app["metrics"].snapshot())
+        for key, val in before.items():
+            assert after.get(key, val) >= val, key
+        assert after[("gordo_stream_refit_failed_total", ())] == 1
+        # scoring kept working on the untouched generation
+        resp = await client.post(
+            "/gordo/v0/p/m3-1/anomaly/prediction",
+            json={"X": [[0.1, 0.2, 0.3]] * 8},
+        )
+        assert resp.status == 200
+        # the fault is exhausted: the retry lands generation 1
+        resp = await client.post(
+            "/gordo/v0/p/adapt", json={"mode": "refit", "targets": ["m3-1"]}
+        )
+        body = await resp.json()
+        assert resp.status == 200 and body["applied"], body
+        assert body["swap"]["generation"] == 1
+        assert app["collection"].models["m3-1"] is not det_before
+
+
+# ------------------------------------------------------------------ #
+# hot-loop guard: GORDO_STREAM=0 costs the scoring path nothing
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.hotloop
+def test_stream_disabled_hot_loop_within_5pct(stream_root):
+    """The default-off contract, quantified: a bank serving WITH an idle
+    streaming plane attached to its app must stay within 5% of one with
+    streaming disabled — the plane adds no per-request work at all (its
+    only scoring-path surface is separate endpoints)."""
+    from gordo_components_tpu.streaming import StreamingPlane
+
+    det = serializer.load(str(stream_root / "m3-0"))
+    models = {f"g-{i}": det for i in range(8)}
+    rng = np.random.RandomState(3)
+    requests = [
+        (name, rng.rand(64, 3).astype("float32"), None) for name in models
+    ]
+    control = ModelBank.from_models(models, registry=False)
+    streamed = ModelBank.from_models(models, registry=False)
+    # an app-shaped dict with a live plane + buffered rows, as enabled
+    # and idle as a real GORDO_STREAM=1 replica between adapt intervals
+    app = {"metrics": None, "collection": None, "bank": streamed}
+    plane = StreamingPlane(app)
+    now = time.time()
+    for name in models:
+        plane.ingest(name, np.arange(64.0) + now - 64, rng.rand(64, 3))
+    for bank in (control, streamed):
+        bank.score_many(requests)
+
+    def timed(bank, iters=40):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bank.score_many(requests)
+        return time.perf_counter() - t0
+
+    ratios = []
+    for _ in range(7):
+        c = timed(control)
+        s = timed(streamed)
+        ratios.append(s / c)
+    assert min(ratios) <= 1.05, ratios
+
+
+# ------------------------------------------------------------------ #
+# client streaming forwarder
+# ------------------------------------------------------------------ #
+
+
+async def test_client_ingest_forwarder(stream_root, monkeypatch):
+    from gordo_components_tpu.client import Client
+    from gordo_components_tpu.observability import get_registry
+
+    async with _stream_client(stream_root, monkeypatch) as client:
+        base = f"http://{client.server.host}:{client.server.port}"
+        prov = _provider()
+        prov.inject(dropout_p=0.1)
+        frame = prov.frame(T_LIVE, 96, TAGS3)
+        # re-anchor event times near now so staleness reads sanely
+        frame.index = pd.to_datetime(
+            (np.arange(96.0) * 10 + time.time() - 960) * 1e9, utc=True
+        )
+        bulk = Client(
+            "p", base_url=base, batch_size=40, deadline_ms=30000.0
+        )
+        totals = await bulk.ingest_async("m3-2", frame)
+        assert totals["accepted"] == 96 and totals["chunks"] == 3
+        # a RangeIndex frame omits timestamps (server stamps arrival
+        # time) instead of posting unparseable "0","1",... strings
+        totals = await bulk.ingest_async(
+            "m3-2", pd.DataFrame(np.random.rand(8, 3).astype("float32"))
+        )
+        assert totals["accepted"] == 8
+        plane = client.server.app["stream"]
+        buf = plane.ingestor.buffers["m3-2"]
+        assert buf.rows_total == 96 + 8
+        assert buf.dropout_cells > 0  # NaNs survived the JSON round-trip
+        # the forwarder counter reached the process registry
+        text = get_registry().render()
+        assert "gordo_client_ingest_rows_total" in text
+        snap = get_registry().snapshot()
+        vals = snap["gordo_client_ingest_rows_total"]["values"]
+        assert any(v["value"] == 96 + 8 for v in vals)
+
+
+# ------------------------------------------------------------------ #
+# watchman fleet drift rollup + degraded calculus
+# ------------------------------------------------------------------ #
+
+
+async def test_watchman_drift_rollup_and_degraded(stream_root, monkeypatch):
+    from gordo_components_tpu.watchman.server import build_watchman_app
+
+    async with _stream_client(stream_root, monkeypatch) as client:
+        base = f"http://{client.server.host}:{client.server.port}"
+        prov, stamp = _provider(), _Stamper(back_s=7200.0)
+        prov.inject(mean_shift=4.0)
+        for k in range(2):
+            ts, vals = prov.batch(T_LIVE + pd.Timedelta(f"{k}h"), 96, TAGS3)
+            await _ingest(client, "m3-1", ts, vals, stamp)
+        wm = TestClient(TestServer(build_watchman_app("p", base)))
+        await wm.start_server()
+        try:
+            rollup = await (await wm.get("/drift?refresh=1")).json()
+            assert rollup["replicas_streaming"] == 1
+            assert rollup["drifted"] == ["m3-1"]
+            assert rollup["worst"]["model"] == "m3-1"
+            assert rollup["worst"]["replica"] == 0
+            assert rollup["worst"]["drift_score"] > 1.0
+            assert rollup["max_staleness_seconds"] is not None
+            assert rollup["stale_degraded"] is False
+            # the health snapshot folds the rollup into its degraded
+            # calculus (drifted members => degraded, with the reason)
+            root_body = await (await wm.get("/")).json()
+            assert root_body["streaming"]["drifted"] == ["m3-1"]
+            assert root_body["status"] == "degraded"
+            assert "drifted" in root_body["degraded_reason"]
+        finally:
+            await wm.close()
+        # staleness beyond GORDO_STALENESS_DEGRADED_S flips the stale path
+        monkeypatch.setenv("GORDO_STALENESS_DEGRADED_S", "0.001")
+        wm = TestClient(TestServer(build_watchman_app("p", base)))
+        await wm.start_server()
+        try:
+            rollup = await (await wm.get("/drift")).json()
+            assert rollup["stale_degraded"] is True
+            root_body = await (await wm.get("/")).json()
+            assert root_body["status"] == "degraded"
+            assert "staleness" in root_body["degraded_reason"]
+        finally:
+            await wm.close()
+
+
+# ------------------------------------------------------------------ #
+# FleetTrainer warm start (the refit substrate)
+# ------------------------------------------------------------------ #
+
+
+def test_fleet_trainer_warm_start_seeds_params():
+    """``initial_params`` overwrites the member's stacked init row: at
+    learning rate 0 the warm weights round-trip bitwise, proving the
+    refit path genuinely fine-tunes the serving weights instead of
+    training from scratch."""
+    import jax
+
+    from gordo_components_tpu.parallel.fleet import FleetTrainer
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(200, 3).astype("float32")
+    base = FleetTrainer(epochs=2, batch_size=64, seed=1).fit({"a": X})["a"]
+    warm = FleetTrainer(
+        epochs=1, batch_size=64, seed=2, learning_rate=0.0
+    ).fit({"a": X}, initial_params={"a": base.params})["a"]
+    for got, want in zip(
+        jax.tree.leaves(warm.params), jax.tree.leaves(base.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # mismatched architectures fail fast naming the member
+    bad = FleetTrainer(epochs=1, batch_size=64, dims=(4,), kind="feedforward_symmetric")
+    with pytest.raises(ValueError, match="initial_params"):
+        bad.fit({"a": X}, initial_params={"a": base.params})
+    with pytest.raises(ValueError, match="unknown member"):
+        FleetTrainer(epochs=1).fit({"a": X}, initial_params={"zz": base.params})
