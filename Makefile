@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -57,6 +57,15 @@ rebalance:
 stream:
 	$(PYTHON) -m pytest tests/ -q -m stream --continue-on-collection-errors
 
+# wire lane: the binary tensor data plane — frame codec round-trips
+# (dtype/shape/endianness, truncated/oversized/malformed -> 400 with
+# reason), JSON-vs-tensor bitwise score parity through the live app
+# (incl. 410 quarantine, 504 deadline, chaos bank.score faults on the
+# binary path), client auto-negotiation + foreign-server downgrade, the
+# per-encoding metric rows, and tensor ingest (tests/test_wire.py)
+wire:
+	$(PYTHON) -m pytest tests/ -q -m wire --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -67,9 +76,11 @@ hotloop:
 # perf-guard lane: every hot-loop overhead guard PLUS the pipelined-vs-
 # serial parity+no-slower check (tests/test_bank_pipeline.py) PLUS the
 # banked-kernel legs (tests/test_banked_kernel.py parity sweep and
-# tests/test_bank_quantized.py fused-kernel>=XLA-at-equal-dtype) — the
+# tests/test_bank_quantized.py fused-kernel>=XLA-at-equal-dtype) PLUS
+# the tensor-path>=JSON-path wire guard (tests/test_wire.py) — the
 # scoring pipeline must never regress below the serial path it replaced,
-# and the fused kernel must never regress below the XLA epilogue
+# the fused kernel below the XLA epilogue, or the binary data plane
+# below the JSON path it bypasses
 perf-guard:
 	$(PYTHON) -m pytest tests/ -q -m "hotloop or perfguard" --continue-on-collection-errors
 
@@ -94,6 +105,11 @@ rebalance-demo:
 # (tools/stream_demo.py; bench.py's `streaming` leg runs the same tool)
 stream-demo:
 	$(PYTHON) tools/stream_demo.py
+
+# posts the same batch as JSON, parquet, and framed tensor bodies and
+# prints rows/s + bytes/row side by side (tools/wire_demo.py)
+wire-demo:
+	$(PYTHON) tools/wire_demo.py
 
 bench:
 	$(PYTHON) bench.py

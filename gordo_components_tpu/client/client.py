@@ -9,8 +9,10 @@ prediction store.
 """
 
 import asyncio
+import functools
 import hashlib
 import itertools
+import json
 import logging
 import random
 import uuid
@@ -19,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import aiohttp
+import numpy as np
 import pandas as pd
 
 from gordo_components_tpu.client.io import (
@@ -33,6 +36,13 @@ from gordo_components_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from gordo_components_tpu.resilience.retry_budget import RetryBudget
 from gordo_components_tpu.server.utils import dict_to_frame
 from gordo_components_tpu.utils import parquet_engine_available
+from gordo_components_tpu.utils.encoding import parquet_engine
+from gordo_components_tpu.utils.wire import (
+    ANOMALY_FRAME_NAMES,
+    TENSOR_CONTENT_TYPE,
+    pack_frames,
+    unpack_frames,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -95,6 +105,7 @@ class Client:
         use_anomaly: bool = True,
         metadata_fallback_dataset: Optional[Dict[str, Any]] = None,
         use_parquet="auto",
+        use_tensor="auto",
         retries: int = 3,
         backoff: float = 0.5,
         retry_budget: Optional[RetryBudget] = None,
@@ -154,6 +165,23 @@ class Client:
             )
         self.use_parquet = use_parquet
         self._parquet_active = False
+        # framed binary tensor bodies (utils/wire.py) — the preferred
+        # encoding when the server advertises application/x-gordo-tensor:
+        # it upgrades BOTH wire directions (request rows and the 4x-larger
+        # anomaly response), where parquet only ever covered the request.
+        # Same negotiation contract as parquet: "auto" upgrades on the
+        # advertisement and downgrades for the rest of the run when a
+        # foreign server rejects a tensor body that JSON then accepts.
+        if use_tensor not in (True, False, "auto"):
+            raise ValueError(
+                f"use_tensor must be True, False or 'auto', got {use_tensor!r}"
+            )
+        self.use_tensor = use_tensor
+        self._tensor_active = False
+        # per-encoding wire accounting (bench's bytes-per-row legs +
+        # gordo_client_request_bytes_total): body bytes out and rows
+        # posted for every scoring POST that got a 2xx back
+        self._wire_stats: Dict[str, Dict[str, int]] = {}
         self._metadata_all: Dict[str, Any] = {}
         # request-id propagation: every scoring POST carries a unique
         # X-Gordo-Request-Id the server threads through its access log and
@@ -221,8 +249,31 @@ class Client:
                 "Stream rows the ingestion forwarder posted and the "
                 "server accepted", labels, c._ingest_stats["rows"],
             )
+            for enc, st in list(c._wire_stats.items()):
+                yield (
+                    "gordo_client_request_bytes_total", "counter",
+                    "Scoring request body bytes posted, by wire encoding",
+                    {**labels, "encoding": enc}, st["bytes_out"],
+                )
 
         get_registry().collector(collect, key=f"bulk_client:{self._rid_prefix}")
+
+    def _note_wire(self, encoding: str, bytes_out: int, rows: int) -> None:
+        """Count a successfully posted scoring chunk against its wire
+        encoding (single event-loop thread: plain dict mutation)."""
+        st = self._wire_stats.setdefault(
+            encoding, {"posts": 0, "bytes_out": 0, "rows": 0}
+        )
+        st["posts"] += 1
+        st["bytes_out"] += int(bytes_out)
+        st["rows"] += int(rows)
+
+    @property
+    def wire_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-encoding wire accounting: POSTs, body bytes out, and rows
+        for every scoring chunk that succeeded — bytes/row per encoding
+        is what the bench's ``client_bulk`` leg records."""
+        return {enc: dict(st) for enc, st in self._wire_stats.items()}
 
     @staticmethod
     def replicas_from_watchman(snapshot: Dict[str, Any]) -> List[str]:
@@ -346,9 +397,21 @@ class Client:
     ) -> List[PredictionResult]:
         timeout = aiohttp.ClientTimeout(total=600)
         sem = asyncio.Semaphore(self.parallelism)
-        async with aiohttp.ClientSession(timeout=timeout) as session:
+        # keep-alive connections bounded a little above the chunk
+        # concurrency: every chunk POST reuses a warm socket instead of
+        # paying handshake latency per request (the default limit is
+        # fine, but pinning it to the parallelism keeps a large
+        # parallelism= from opening sockets the semaphore never fills)
+        connector = aiohttp.TCPConnector(limit=max(self.parallelism + 4, 8))
+        async with aiohttp.ClientSession(
+            timeout=timeout, connector=connector
+        ) as session:
             models_body = None
-            if targets is None or self.use_parquet == "auto":
+            if (
+                targets is None
+                or self.use_parquet == "auto"
+                or self.use_tensor == "auto"
+            ):
                 try:
                     models_body = await fetch_json(
                         session,
@@ -371,6 +434,16 @@ class Client:
                 # below that, per-target GETs are cheaper than pulling the
                 # whole fleet's metadata for a handful of lookups
                 await self._prefetch_metadata(session)
+            if self.use_tensor == "auto":
+                # tensor-first negotiation: exact content-type match (a
+                # substring test would let a foreign "x-gordo-tensor-v9"
+                # advertisement negotiate a format we don't speak)
+                self._tensor_active = any(
+                    a == TENSOR_CONTENT_TYPE
+                    for a in (models_body or {}).get("accepts", [])
+                )
+            else:
+                self._tensor_active = bool(self.use_tensor)
             if self.use_parquet == "auto":
                 self._parquet_active = parquet_engine_available() and any(
                     "parquet" in a
@@ -397,6 +470,24 @@ class Client:
                     self.forwarder.forward(result)
         return list(results)
 
+    @staticmethod
+    def _encode_parquet(chunk: pd.DataFrame, chunk_y) -> bytes:
+        """Serialize one chunk as parquet bytes (runs on an executor
+        thread: CPU-bound encoding must not stall the event loop that is
+        pumping the in-flight POSTs — the overlap half of the data-plane
+        win). Engine pinned once (utils/encoding.py) so pandas' per-call
+        "auto" resolution never rides the chunk loop."""
+        import io
+
+        frame = chunk
+        if chunk_y is not None:
+            # indices are identical by construction (iloc slices of the
+            # same row range), so this is a pure column concat
+            frame = pd.concat([chunk, chunk_y.add_prefix("__y__")], axis=1)
+        buf = io.BytesIO()
+        frame.to_parquet(buf, engine=parquet_engine() or "auto")
+        return buf.getvalue()
+
     async def _post_parquet(
         self, session, target, endpoint, chunk: pd.DataFrame,
         chunk_y: Optional[pd.DataFrame] = None,
@@ -407,30 +498,107 @@ class Client:
         so timestamps round-trip without the JSON string lists). Target
         columns for supervised machines are embedded under a ``__y__``
         prefix; the server splits them back out (server/utils.py)."""
-        import io
-
-        frame = chunk
-        if chunk_y is not None:
-            # indices are identical by construction (iloc slices of the
-            # same row range), so this is a pure column concat
-            frame = pd.concat([chunk, chunk_y.add_prefix("__y__")], axis=1)
-        buf = io.BytesIO()
-        frame.to_parquet(buf)
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, self._encode_parquet, chunk, chunk_y
+        )
         headers = {"Content-Type": "application/x-parquet"}
         if request_id:
             headers.update(self._trace_headers(request_id))
-        return await fetch_json_hedged(
+        resp = await fetch_json_hedged(
             session,
             self._chunk_urls(target, endpoint),
             hedge_delay_s=self._hedge_delay_s(),
             hedge_stats=self._hedge_stats,
             method="POST",
-            data=buf.getvalue(),
+            data=body,
             headers=headers,
             retries=self.retries,
             backoff=self.backoff,
             retry_budget=self.retry_budget,
             deadline=deadline,
+        )
+        self._note_wire("parquet", len(body), len(chunk))
+        return resp
+
+    @staticmethod
+    def _encode_tensor(chunk: pd.DataFrame, chunk_y) -> bytes:
+        """One chunk as a framed tensor body (utils/wire.py): the float32
+        rows in C order, one memory copy total. Runs on an executor
+        thread so chunk k+1 serializes while chunk k's POST is in flight
+        (with tensor framing the encode is ~µs — the executor hop is for
+        symmetry with the other encoders and for very large chunks)."""
+        frames = [("X", np.ascontiguousarray(chunk.values, dtype=np.float32))]
+        if chunk_y is not None:
+            frames.append(
+                ("y", np.ascontiguousarray(chunk_y.values, dtype=np.float32))
+            )
+        return pack_frames(frames)
+
+    def _decode_tensor_scoring_body(
+        self, body: bytes, chunk: pd.DataFrame, anomaly: bool
+    ) -> pd.DataFrame:
+        """Tensor response -> the SAME DataFrame the JSON path builds
+        (column-for-column, value-for-value: float32 -> float64 is exact,
+        so frames from either encoding are bitwise interchangeable). The
+        index is the client's own chunk index trimmed by the server's
+        ``offset`` — no stringified-timestamp round trip."""
+        frames = unpack_frames(body)
+        meta = json.loads(bytes(frames.pop("__meta__")))
+        offset = int(meta.get("offset", 0))
+        if anomaly:
+            tags = meta["tags"]
+            cols: Dict[Any, np.ndarray] = {}
+            for top in ANOMALY_FRAME_NAMES[:4]:
+                arr = frames[top].astype(np.float64)
+                for i, tag in enumerate(tags):
+                    cols[(top, tag)] = arr[:, i]
+            for top in ANOMALY_FRAME_NAMES[4:]:
+                cols[(top, "")] = frames[top].astype(np.float64)
+            df = pd.DataFrame(cols)
+            df.columns = pd.MultiIndex.from_tuples(df.columns)
+        else:
+            df = pd.DataFrame(frames["data"].astype(np.float64))
+        df.index = chunk.index[offset : offset + len(df)]
+        return df
+
+    async def _post_tensor(
+        self, session, target, endpoint, chunk: pd.DataFrame,
+        chunk_y: Optional[pd.DataFrame] = None,
+        request_id: Optional[str] = None,
+        deadline: Optional[Deadline] = None,
+    ) -> pd.DataFrame:
+        """POST one chunk as a framed tensor body and decode the binary
+        response straight into the result frame."""
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, self._encode_tensor, chunk, chunk_y
+        )
+        headers = {"Content-Type": TENSOR_CONTENT_TYPE}
+        if request_id:
+            headers.update(self._trace_headers(request_id))
+        resp = await fetch_json_hedged(
+            session,
+            self._chunk_urls(target, endpoint),
+            hedge_delay_s=self._hedge_delay_s(),
+            hedge_stats=self._hedge_stats,
+            method="POST",
+            data=body,
+            headers=headers,
+            retries=self.retries,
+            backoff=self.backoff,
+            retry_budget=self.retry_budget,
+            deadline=deadline,
+        )
+        if not isinstance(resp, (bytes, bytearray)):
+            # a 200 with a JSON body to a tensor POST is a foreign server
+            # that ignored the content type; surface it like a rejection
+            # so auto mode downgrades instead of mis-parsing
+            raise ValueError(
+                f"server answered a tensor POST with {type(resp).__name__}, "
+                "not a tensor body"
+            )
+        self._note_wire("tensor", len(body), len(chunk))
+        return self._decode_tensor_scoring_body(
+            resp, chunk, anomaly=endpoint.startswith("anomaly")
         )
 
     async def _predict_single(
@@ -481,10 +649,11 @@ class Client:
 
         async def post_chunk(chunk: pd.DataFrame, chunk_y: Optional[pd.DataFrame]):
             async with sem:
-                # one id per chunk, reused across the parquet->JSON
-                # downgrade re-post: both attempts are the SAME request.
-                # Likewise ONE deadline: the downgrade re-post spends
-                # what remains of the chunk's budget, not a fresh one.
+                # one id per chunk, reused across the tensor/parquet ->
+                # JSON downgrade re-posts: every attempt is the SAME
+                # request. Likewise ONE deadline: a downgrade re-post
+                # spends what remains of the chunk's budget, not a fresh
+                # one.
                 rid = self._next_request_id()
                 deadline = (
                     Deadline.after_ms(self.deadline_ms)
@@ -492,7 +661,32 @@ class Client:
                     else None
                 )
                 t0 = asyncio.get_running_loop().time()
-                parquet_exc = None
+                tensor_exc = parquet_exc = None
+                if self._tensor_active:
+                    try:
+                        frame = await self._post_tensor(
+                            session, target, endpoint, chunk, chunk_y,
+                            request_id=rid, deadline=deadline,
+                        )
+                        self._latency.record(
+                            asyncio.get_running_loop().time() - t0
+                        )
+                        return frame
+                    except ValueError as exc:
+                        # 4xx on the tensor body: foreign server (or a
+                        # genuine model error that any encoding would
+                        # 400). The fallback posts below disambiguate —
+                        # forced mode never downgrades, same contract as
+                        # parquet.
+                        if self.use_tensor is True:
+                            errors.append(
+                                f"chunk {chunk.index[0]} (rid={rid}): {exc}"
+                            )
+                            return None
+                        tensor_exc = exc
+                    except Exception as exc:
+                        errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
+                        return None
                 if self._parquet_active:
                     try:
                         body = await self._post_parquet(
@@ -502,6 +696,14 @@ class Client:
                         self._latency.record(
                             asyncio.get_running_loop().time() - t0
                         )
+                        if tensor_exc is not None:
+                            # parquet succeeded where tensor 4xx'd: an
+                            # encoding problem — downgrade the run
+                            logger.warning(
+                                "tensor body rejected (%s) but parquet "
+                                "succeeded; downgrading run", tensor_exc,
+                            )
+                            self._tensor_active = False
                         return body
                     except ValueError as exc:
                         # 4xx on the parquet body. Ambiguous: the server
@@ -523,6 +725,15 @@ class Client:
                 }
                 if chunk_y is not None:
                     payload["y"] = chunk_y.values.tolist()
+                # encode off the event loop (same overlap contract as the
+                # binary encoders: a 500-row float-list dumps() is
+                # milliseconds the in-flight POSTs shouldn't stall on),
+                # and as bytes so the wire accounting sees real sizes
+                json_body = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    functools.partial(json.dumps, payload, ensure_ascii=False),
+                )
+                json_body = json_body.encode("utf-8")
                 try:
                     body = await fetch_json_hedged(
                         session,
@@ -530,14 +741,18 @@ class Client:
                         hedge_delay_s=self._hedge_delay_s(),
                         hedge_stats=self._hedge_stats,
                         method="POST",
-                        json_payload=payload,
-                        headers=self._trace_headers(rid),
+                        data=json_body,
+                        headers={
+                            "Content-Type": "application/json",
+                            **self._trace_headers(rid),
+                        },
                         retries=self.retries,
                         backoff=self.backoff,
                         retry_budget=self.retry_budget,
                         deadline=deadline,
                     )
                     self._latency.record(asyncio.get_running_loop().time() - t0)
+                    self._note_wire("json", len(json_body), len(chunk))
                 except DeadlineExceeded as exc:
                     errors.append(
                         f"chunk {chunk.index[0]} (rid={rid}): deadline: {exc}"
@@ -546,6 +761,12 @@ class Client:
                 except Exception as exc:
                     errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
                     return None
+                if tensor_exc is not None:
+                    logger.warning(
+                        "tensor body rejected (%s) but JSON succeeded; "
+                        "downgrading run", tensor_exc,
+                    )
+                    self._tensor_active = False
                 if parquet_exc is not None:
                     # JSON succeeded where parquet 4xx'd: an encoding
                     # problem, not a model error — downgrade the rest of
@@ -572,7 +793,10 @@ class Client:
         for body in bodies:
             if body is None:
                 continue
-            if "data" in body and isinstance(body["data"], dict):
+            if isinstance(body, pd.DataFrame):
+                # the tensor path decodes straight to the result frame
+                frames.append(body)
+            elif "data" in body and isinstance(body["data"], dict):
                 frames.append(dict_to_frame(body))
             elif "data" in body:
                 df = pd.DataFrame(body["data"])
@@ -586,12 +810,14 @@ class Client:
     # streaming forwarder
     # ------------------------------------------------------------------ #
 
-    def ingest(self, target: str, X, timestamps=None) -> Dict[str, int]:
+    def ingest(
+        self, target: str, X, timestamps=None, tensor: bool = False
+    ) -> Dict[str, int]:
         """Synchronous wrapper over :meth:`ingest_async`."""
-        return asyncio.run(self.ingest_async(target, X, timestamps))
+        return asyncio.run(self.ingest_async(target, X, timestamps, tensor=tensor))
 
     async def ingest_async(
-        self, target: str, X, timestamps=None
+        self, target: str, X, timestamps=None, tensor: bool = False
     ) -> Dict[str, int]:
         """Streaming forwarder: POST fresh rows to the server's
         ``.../{target}/ingest`` window buffer in ``batch_size``-row
@@ -608,6 +834,13 @@ class Client:
         (``accepted``/``late``/``dropped`` rows + chunks posted) and
         feeds ``gordo_client_ingest_rows_total``.
 
+        ``tensor=True`` posts each chunk as a framed tensor body (the
+        scoring plane's wire format, utils/wire.py): float32 ``rows``
+        (NaN cells ARE the dropout markers — no null boxing) plus a
+        float64 epoch-seconds ``timestamps`` frame. Explicit opt-in
+        because the ingest path does no ``/models`` negotiation — use it
+        against gordo servers, not foreign ones.
+
         Delivery is AT-LEAST-ONCE: a chunk the server ingested whose
         response was lost gets retried and its rows ingested twice.
         That is the right trade for a drift window (a few duplicated
@@ -622,43 +855,89 @@ class Client:
                 # — omit instead, the server stamps arrival time
                 timestamps = [str(i) for i in X.index]
         else:
-            import numpy as np
-
             values = np.asarray(X)
+        epoch_ts = None
+        if tensor and timestamps is not None:
+            # the wire frame wants epoch seconds; string/Timestamp forms
+            # are normalized once up front (ns -> s, matching the server)
+            ts_list = list(timestamps)
+            if ts_list and isinstance(
+                ts_list[0], (int, float, np.integer, np.floating)
+            ):
+                epoch_ts = np.asarray(ts_list, np.float64)
+            else:  # ISO strings / Timestamps: one vectorized parse
+                epoch_ts = (
+                    pd.to_datetime(ts_list, utc=True).as_unit("ns").asi8 / 1e9
+                )
         totals = {"accepted": 0, "late": 0, "dropped": 0, "chunks": 0}
         url = self._url(target, "ingest")
         timeout = aiohttp.ClientTimeout(total=600)
         async with aiohttp.ClientSession(timeout=timeout) as session:
             for i in range(0, len(values), self.batch_size):
                 chunk = values[i : i + self.batch_size]
-                rows = [
-                    [None if v != v else float(v) for v in row]
-                    for row in chunk.tolist()
-                ]
-                payload: Dict[str, Any] = {"rows": rows}
-                if timestamps is not None:
-                    ts = list(timestamps[i : i + self.batch_size])
-                    payload["timestamps"] = [
-                        t if isinstance(t, (int, float, str)) else str(t)
-                        for t in ts
-                    ]
                 rid = self._next_request_id()
                 deadline = (
                     Deadline.after_ms(self.deadline_ms)
                     if self.deadline_ms is not None
                     else None
                 )
-                body = await fetch_json(
-                    session,
-                    url,
-                    method="POST",
-                    json_payload=payload,
-                    headers=self._trace_headers(rid),
-                    retries=self.retries,
-                    backoff=self.backoff,
-                    retry_budget=self.retry_budget,
-                    deadline=deadline,
-                )
+                if tensor:
+                    frames = [
+                        ("rows", np.ascontiguousarray(chunk, dtype=np.float32))
+                    ]
+                    if epoch_ts is not None:
+                        frames.append(
+                            ("timestamps", epoch_ts[i : i + self.batch_size])
+                        )
+                    data = pack_frames(frames)
+                    body = await fetch_json(
+                        session,
+                        url,
+                        method="POST",
+                        data=data,
+                        headers={
+                            "Content-Type": TENSOR_CONTENT_TYPE,
+                            **self._trace_headers(rid),
+                        },
+                        retries=self.retries,
+                        backoff=self.backoff,
+                        retry_budget=self.retry_budget,
+                        deadline=deadline,
+                    )
+                    # its own bucket: mixing ingest traffic into the
+                    # scoring "tensor" cell would skew the bytes-per-row
+                    # comparison the bench legs read
+                    self._note_wire("ingest-tensor", len(data), len(chunk))
+                else:
+                    rows = [
+                        [None if v != v else float(v) for v in row]
+                        for row in chunk.tolist()
+                    ]
+                    payload: Dict[str, Any] = {"rows": rows}
+                    if timestamps is not None:
+                        ts = list(timestamps[i : i + self.batch_size])
+                        payload["timestamps"] = [
+                            t if isinstance(t, (int, float, str)) else str(t)
+                            for t in ts
+                        ]
+                    data = json.dumps(payload).encode("utf-8")
+                    body = await fetch_json(
+                        session,
+                        url,
+                        method="POST",
+                        data=data,
+                        headers={
+                            "Content-Type": "application/json",
+                            **self._trace_headers(rid),
+                        },
+                        retries=self.retries,
+                        backoff=self.backoff,
+                        retry_budget=self.retry_budget,
+                        deadline=deadline,
+                    )
+                    # symmetric with the tensor branch: ingest bytes in
+                    # their own bucket, never the scoring cells
+                    self._note_wire("ingest-json", len(data), len(chunk))
                 totals["chunks"] += 1
                 for key in ("accepted", "late", "dropped"):
                     totals[key] += int(body.get(key, 0))

@@ -65,12 +65,21 @@ class ForwardPredictionsIntoParquet:
         os.makedirs(root, exist_ok=True)
 
     def forward(self, result) -> None:
+        from gordo_components_tpu.utils.encoding import parquet_engine
+
         path = os.path.join(self.root, f"{result.name}.parquet")
-        df = result.predictions.copy()
+        df = result.predictions
         if hasattr(df.columns, "to_flat_index"):
+            # shallow copy shares the data blocks (verified with
+            # np.shares_memory) and only the column labels are replaced —
+            # the old deep .copy() duplicated the whole backfill frame
+            # just to rename columns for the parquet writer
+            df = df.copy(deep=False)
             df.columns = [
-                "|".join(c for c in col if c) if isinstance(col, tuple) else str(col)
+                "|".join(c for c in col if c)
+                if isinstance(col, tuple)
+                else str(col)
                 for col in df.columns.to_flat_index()
             ]
-        df.to_parquet(path)
+        df.to_parquet(path, engine=parquet_engine() or "auto")
         logger.info("Wrote predictions for %s -> %s", result.name, path)

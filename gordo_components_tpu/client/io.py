@@ -36,6 +36,7 @@ from gordo_components_tpu.resilience.retry_budget import (
     RetryBudget,
     decorrelated_jitter,
 )
+from gordo_components_tpu.utils.wire import TENSOR_CONTENT_TYPE
 
 logger = logging.getLogger(__name__)
 
@@ -192,6 +193,11 @@ async def fetch_json(
             if resp.status >= 400:
                 body = await resp.text()
                 raise ValueError(f"HTTP {resp.status} from {url}: {body[:500]}")
+            if resp.content_type == TENSOR_CONTENT_TYPE:
+                # binary scoring response (the framed tensor wire format,
+                # utils/wire.py): hand the raw body back — the caller
+                # owns the decode, exactly as it owns the JSON schema
+                return await resp.read()
             return await resp.json()
 
     last_exc: Optional[Exception] = None

@@ -80,6 +80,22 @@ async def _stats_middleware(request, handler):
     else:
         kind = canonical.rsplit("/", 1)[-1] or "/"
     stats["requests"][kind] = stats["requests"].get(kind, 0) + 1
+    if request.method == "POST" and kind in ("prediction", "anomaly", "ingest"):
+        # per-encoding data-plane accounting (stability contract:
+        # gordo_server_requests_total{encoding} + request_bytes_total):
+        # which wire format the fleet's clients actually negotiate, and
+        # the bytes each moves — the numbers the tensor-vs-JSON bench
+        # legs and the bytes-per-row dashboards read. ONE classification
+        # rule shared with the scoring handlers (utils/wire.py), so the
+        # metrics can never disagree with the path a request took.
+        from gordo_components_tpu.utils.wire import encoding_of
+
+        enc = encoding_of(request.content_type)
+        wire = stats["wire"]
+        wire["requests"][enc] = wire["requests"].get(enc, 0) + 1
+        wire["bytes"][enc] = (
+            wire["bytes"].get(enc, 0) + (request.content_length or 0)
+        )
     hist = stats["latency"].get(kind)
     if hist is None:
         hist = stats["latency"][kind] = LatencyHistogram()
@@ -216,6 +232,25 @@ def _server_collector(app: web.Application):
             "gordo_server_errors_total", "counter",
             "HTTP responses with status >= 400", {}, stats["errors"],
         )
+        # the data plane by encoding (stability contract): scoring/ingest
+        # POSTs and their body bytes, labeled json|parquet|tensor. NOTE
+        # for aggregators: these share the requests_total family with the
+        # {kind} samples, so a scoring POST appears under BOTH label
+        # dimensions — sum() by one label, never over the whole family
+        # (docs/observability.md spells this out)
+        for enc, n in stats["wire"]["requests"].items():
+            yield (
+                "gordo_server_requests_total", "counter",
+                "Scoring/ingest POSTs by wire encoding "
+                "(second label dimension of requests_total)",
+                {"encoding": enc}, n,
+            )
+        for enc, n in stats["wire"]["bytes"].items():
+            yield (
+                "gordo_server_request_bytes_total", "counter",
+                "Scoring/ingest request body bytes by wire encoding",
+                {"encoding": enc}, n,
+            )
         for kind, hist in stats["latency"].items():
             yield (
                 "gordo_server_request_seconds", "histogram",
@@ -374,6 +409,9 @@ def build_app(
         "errors": 0,
         "latency": {},
         "exemplars": {},
+        # per-encoding data-plane counters (json|parquet|tensor): scoring
+        # /ingest POST counts + request body bytes, fed by the middleware
+        "wire": {"requests": {}, "bytes": {}},
     }
     # operator default request budget (ms): applied by the middleware to
     # every request that carries no X-Gordo-Deadline-Ms header; None

@@ -508,6 +508,23 @@ class ScoreResult:
             index,
         )
 
+    def to_arrays(self) -> Dict[str, np.ndarray]:
+        """ndarray-out counterpart of :meth:`to_frame` for the binary
+        wire path (server/model_io.py): the same trimmed arrays a frame
+        would hold, keyed by the frame's top-level column names — but as
+        the fetched device buffers themselves, with no DataFrame
+        assembly, no per-column ``tolist``, and no float64 upcast. The
+        input trim is a view; everything else is returned as-is."""
+        n_out = len(self.model_output)
+        return {
+            "model-input": np.asarray(self.model_input)[self.offset :][:n_out],
+            "model-output": self.model_output,
+            "tag-anomaly-unscaled": self.diff,
+            "tag-anomaly-scaled": self.scaled,
+            "total-anomaly-unscaled": self.total_unscaled,
+            "total-anomaly-scaled": self.total_scaled,
+        }
+
 
 def _slice_single(outs, slot, n_out: int):
     """Single-chunk reassembly (the serving-path norm): one sliced copy

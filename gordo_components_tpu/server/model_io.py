@@ -1,4 +1,4 @@
-"""Model loading for the server.
+"""Model loading + model-boundary wire I/O for the server.
 
 Reference parity: gordo_components/server/model_io.py (unverified; SURVEY.md
 §2 "server") — the reference loads ONE artifact per server process (env
@@ -6,16 +6,105 @@ Reference parity: gordo_components/server/model_io.py (unverified; SURVEY.md
 a directory of per-machine artifact dirs loaded into one process so a whole
 fleet shares a chip's HBM (BASELINE.json config 5); a single artifact dir
 still works and behaves like the reference.
+
+Also the binary scoring data plane's server half (PR 10): decode a
+``application/x-gordo-tensor`` request body straight into the float32
+arrays the bank scores (``np.frombuffer`` view, no DataFrame), and encode
+score arrays straight into one preallocated response body (utils/wire.py).
 """
 
+import json
 import logging
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
 
 from gordo_components_tpu import serializer
 from gordo_components_tpu.resilience.faults import faultpoint
+from gordo_components_tpu.utils.wire import (
+    ANOMALY_FRAME_NAMES,
+    WireFormatError,
+    pack_frames,
+    rows_as_f32,
+    unpack_frames,
+)
 
 logger = logging.getLogger(__name__)
+
+
+def decode_tensor_request(
+    raw: bytes,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Tensor request body -> ``(X, y)`` float32 arrays.
+
+    The body must carry an ``X`` frame (rows x features); ``y`` is
+    optional (supervised targets). Native little-endian float32 payloads
+    come back as zero-copy read-only views of ``raw`` — the bank's
+    coalescing stage copies rows into arena staging buffers anyway, so
+    nothing downstream needs writability. Raises
+    :class:`~gordo_components_tpu.utils.wire.WireFormatError` (-> 400
+    with the reason) on malformed bodies.
+    """
+    frames = unpack_frames(raw)
+    if "X" not in frames:
+        raise WireFormatError(
+            f"tensor body must carry an 'X' frame (got {sorted(frames)})"
+        )
+    X = rows_as_f32(frames["X"], "X")
+    y = rows_as_f32(frames["y"], "y") if "y" in frames else None
+    if y is not None and len(y) != len(X):
+        raise WireFormatError(
+            f"y has {len(y)} rows but X has {len(X)}"
+        )
+    return X, y
+
+
+def _meta_frame(meta: Dict[str, Any]) -> Tuple[str, np.ndarray]:
+    """Small JSON sidecar riding as a u1 frame: offsets/tags — the few
+    non-tensor facts a client needs to reassemble an indexed frame."""
+    return "__meta__", np.frombuffer(json.dumps(meta).encode("utf-8"), np.uint8)
+
+
+def encode_prediction_response(output: np.ndarray, n_input_rows: int) -> bytes:
+    """``POST /prediction`` tensor response: a ``data`` frame plus the
+    sequence-warmup ``offset`` (output row i is input row i + offset) in
+    ``__meta__`` — the client trims its own index by it, replacing the
+    JSON body's stringified index round-trip."""
+    output = np.asarray(output)
+    return pack_frames(
+        [
+            _meta_frame({"offset": int(n_input_rows - len(output))}),
+            ("data", output),
+        ]
+    )
+
+
+def encode_anomaly_response(
+    tags, arrays: Dict[str, np.ndarray], offset: int
+) -> bytes:
+    """``POST /anomaly/prediction`` tensor response: the six score arrays
+    (``ScoreResult.to_arrays`` order) written into one preallocated body
+    — no DataFrame assembly, no per-column ``tolist``."""
+    meta = _meta_frame({"offset": int(offset), "tags": [str(t) for t in tags]})
+    return pack_frames(
+        [meta] + [(name, arrays[name]) for name in ANOMALY_FRAME_NAMES]
+    )
+
+
+def anomaly_frame_arrays(frame) -> Dict[str, np.ndarray]:
+    """The wire arrays from an assembled anomaly DataFrame — the
+    per-model fallback path scores through ``model.anomaly`` (which
+    builds the frame); the banked path never builds one
+    (``ScoreResult.to_arrays``)."""
+    return {
+        "model-input": frame["model-input"].to_numpy(),
+        "model-output": frame["model-output"].to_numpy(),
+        "tag-anomaly-unscaled": frame["tag-anomaly-unscaled"].to_numpy(),
+        "tag-anomaly-scaled": frame["tag-anomaly-scaled"].to_numpy(),
+        "total-anomaly-unscaled": frame[("total-anomaly-unscaled", "")].to_numpy(),
+        "total-anomaly-scaled": frame[("total-anomaly-scaled", "")].to_numpy(),
+    }
 
 # chaos site: artifact deserialization (tests/test_chaos.py drives it);
 # firing inside _load_one lands the failure in refresh()'s per-entry

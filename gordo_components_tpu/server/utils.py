@@ -88,7 +88,13 @@ def extract_x_y(
     supports both, SURVEY.md §2 "server").
     """
     if "parquet" in content_type:
-        df = pd.read_parquet(io.BytesIO(raw))
+        from gordo_components_tpu.utils.encoding import parquet_engine
+
+        # engine pinned once (utils/encoding.py): skips pandas' "auto"
+        # resolution (a first-chunk cold-start cost; the steady-state
+        # parquet-vs-JSON story is in docs/architecture.md "Wire
+        # protocol" — the response side is why parquet never won)
+        df = pd.read_parquet(io.BytesIO(raw), engine=parquet_engine() or "auto")
         # supervised targets ride in the same file under a __y__ prefix
         # (client/client.py::_post_parquet): split them back out
         ycols = [c for c in df.columns if str(c).startswith("__y__")]

@@ -30,12 +30,25 @@ from gordo_components_tpu import __version__, serializer
 from gordo_components_tpu.observability.tracing import chrome_trace
 from gordo_components_tpu.resilience.deadline import DeadlineExceeded
 from gordo_components_tpu.server.bank import EngineOverloaded
+from gordo_components_tpu.server.model_io import (
+    anomaly_frame_arrays,
+    decode_tensor_request,
+    encode_anomaly_response,
+    encode_prediction_response,
+)
 from gordo_components_tpu.server.utils import (
     extract_x_y,
     frame_to_dict,
     get_reload_lock,
 )
 from gordo_components_tpu.utils import parquet_engine_available
+from gordo_components_tpu.utils.wire import (
+    TENSOR_CONTENT_TYPE,
+    WireFormatError,
+    encoding_of,
+    rows_as_f32,
+    unpack_frames,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -97,14 +110,31 @@ def _quarantine_gate(request: web.Request) -> None:
     )
 
 
-def _note_scoring_result(request: web.Request, target: str, X, values) -> None:
+def _request_encoding(request: web.Request) -> str:
+    """The scoring-POST body encoding, from the content type alone — the
+    binary path's OPT-IN switch (the shared rule in utils/wire.py, also
+    what the middleware's per-encoding counters classify by)."""
+    return encoding_of(request.content_type)
+
+
+def _note_scoring_result(
+    request: web.Request, target: str, X_arr: np.ndarray, values
+) -> None:
     """Record a completed score with the quarantine breaker: finite
     output resets the failure streak; non-finite output (NaN/Inf anywhere
     in ``values``) counts as a failure — UNLESS the request's own input
     was non-finite, which is the client's data, not the model's fault.
     The input scan only runs on the (rare) non-finite path. The
     finiteness verdict is also stashed for the goodput ledger: a 200
-    carrying NaN scores is wasted work, not goodput."""
+    carrying NaN scores is wasted work, not goodput.
+
+    ``X_arr`` is the float32 array the model actually scored — the
+    handlers validate/convert the request payload ONCE and reuse that
+    one array here, instead of the old second
+    ``np.asarray(X.values, dtype="float64")`` shadow copy per non-finite
+    check (and the verdict is now about the values the model truly saw:
+    a float64 payload the float32 cast turned infinite IS non-finite
+    input from the model's point of view)."""
     quarantine = request.app.get("quarantine")
     ledger = request.app.get("goodput")
     if quarantine is None and ledger is None:
@@ -113,9 +143,7 @@ def _note_scoring_result(request: web.Request, target: str, X, values) -> None:
     finite = bool(np.all(np.isfinite(arr)))
     input_finite = True
     if not finite:
-        input_finite = bool(
-            np.all(np.isfinite(np.asarray(X.values, dtype="float64")))
-        )
+        input_finite = bool(np.all(np.isfinite(X_arr)))
     if ledger is not None:
         # same exemption the breaker applies: NaN-in-NaN-out is the
         # client's data — the server did its work, so it is not wasted
@@ -220,12 +248,16 @@ async def list_models(request: web.Request) -> web.Response:
     body = {
         "project": request.match_info["project"],
         "models": _collection(request).names(),
-        # advertised request encodings: the bulk client upgrades its POST
-        # bodies to parquet when it sees this (client/client.py) — JSON
-        # float-list encode/decode dominates at fleet-backfill scale.
-        # Parquet only when a parse engine is actually importable, or
+        # advertised request encodings, in the server's preference order:
+        # the bulk client upgrades its POST bodies to the best one it
+        # also speaks (client/client.py). Tensor first — the framed
+        # binary format (utils/wire.py) upgrades BOTH directions of the
+        # wire and needs only numpy; parquet is deliberately demoted
+        # below it (it only ever covered the request body, so it never
+        # moved the bulk ratio — docs/architecture.md "Wire protocol")
+        # and advertised only when a parse engine is importable, or
         # every advertised-then-posted body would 500.
-        "accepts": ["application/json"]
+        "accepts": ["application/json", TENSOR_CONTENT_TYPE]
         + (["application/x-parquet"] if _PARQUET_OK else []),
     }
     bank = _bank_coverage(request, body["models"])
@@ -457,6 +489,13 @@ async def server_stats(request: web.Request) -> web.Response:
         # into GET .../traces?id=... to see where that request's time
         # went (metric spike -> offending trace in two clicks)
         "exemplars": stats.get("exemplars", {}),
+        # the data plane by encoding (json|parquet|tensor): scoring and
+        # ingest POST counts + request body bytes — the same cells the
+        # gordo_server_request{,_bytes}_total{encoding} series render
+        "wire": {
+            "requests": dict(stats.get("wire", {}).get("requests", {})),
+            "bytes": dict(stats.get("wire", {}).get("bytes", {})),
+        },
     }
     engine = request.app.get("bank_engine")
     if engine is not None:
@@ -747,10 +786,42 @@ async def ingest_rows(request: web.Request) -> web.Response:
     means "arrived now"); ``null`` cells mark sensor dropout. Late rows
     (behind the watermark by more than ``GORDO_STREAM_LATENESS_S``) are
     counted and dropped, out-of-order rows within the allowance are
-    accepted — the response reports both."""
+    accepted — the response reports both.
+
+    Binary bodies (``application/x-gordo-tensor``, the scoring plane's
+    frame format) carry a float32 ``rows`` frame (NaN cells = dropout —
+    the wire needs no null boxing) and an optional float64 epoch-seconds
+    ``timestamps`` frame; live windows stream at the same zero-copy cost
+    as scoring."""
     plane = _stream_plane(request)
     _get_model(request)  # 404 for unknown targets, same as scoring
     target = request.match_info["target"]
+    if _request_encoding(request) == "tensor":
+        raw = await request.read()
+        try:
+            frames = unpack_frames(raw)
+            if "rows" not in frames:
+                raise WireFormatError(
+                    f"tensor ingest body must carry a 'rows' frame "
+                    f"(got {sorted(frames)})"
+                )
+            values = rows_as_f32(frames["rows"], "rows")
+            ts = frames.get("timestamps")
+            if ts is None:
+                event_ts = np.full((len(values),), time.time())
+            else:
+                event_ts = np.asarray(ts, np.float64).reshape(-1)
+                if len(event_ts) != len(values):
+                    raise WireFormatError(
+                        f"{len(event_ts)} timestamps for {len(values)} rows"
+                    )
+            counts = plane.ingest(target, event_ts, values)
+        except (WireFormatError, ValueError) as exc:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": str(exc)}),
+                content_type="application/json",
+            )
+        return web.json_response({"target": target, **counts})
     try:
         body = await request.json()
     except Exception:
@@ -896,17 +967,55 @@ async def _parse_request(request: web.Request):
     return extract_x_y(body)
 
 
+async def _parse_scoring(request: web.Request):
+    """Parse a scoring POST once, by encoding.
+
+    Returns ``(encoding, X, y, Xf, yf)``: ``Xf``/``yf`` are the float32
+    arrays scoring consumes (validated ONCE and reused by the finiteness
+    breaker — the old second float64 copy in ``_note_scoring_result`` is
+    gone); ``X``/``y`` DataFrames exist only on the JSON/parquet paths
+    (``None`` for tensor — its fast path never builds one). The ``parse``
+    stage span carries the encoding, so per-encoding parse cost is
+    visible in traces (docs/observability.md)."""
+    encoding = _request_encoding(request)
+    trace = request.get("trace")
+    t_parse = time.monotonic()
+    X = y = yf = None
+    if encoding == "tensor":
+        raw = await request.read()
+        try:
+            # bytes -> frombuffer views -> float32 rows; no DataFrame,
+            # no per-value boxing (server/model_io.py, utils/wire.py)
+            Xf, yf = decode_tensor_request(raw)
+        except WireFormatError as exc:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": f"tensor body: {exc}"}),
+                content_type="application/json",
+            )
+    else:
+        try:
+            X, y = await _parse_request(request)
+        except ValueError as exc:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": str(exc)}),
+                content_type="application/json",
+            )
+        # no-copy when the parse already produced float32 (the old
+        # .astype("float32") unconditionally copied per request)
+        Xf = np.asarray(X.values, dtype="float32")
+        if y is not None:
+            yf = np.asarray(y.values, dtype="float32")
+    if trace is not None:
+        trace.add_span("parse", t_parse, time.monotonic(), encoding=encoding)
+    return encoding, X, y, Xf, yf
+
+
 @routes.post("/gordo/v0/{project}/{target}/prediction")
 async def prediction(request: web.Request) -> web.Response:
     model, _ = _get_model(request)
     _quarantine_gate(request)
     target = request.match_info["target"]
-    try:
-        X, _y = await _parse_request(request)
-    except ValueError as exc:
-        raise web.HTTPBadRequest(
-            text=json.dumps({"error": str(exc)}), content_type="application/json"
-        )
+    encoding, X, _y, Xf, _yf = await _parse_scoring(request)
     engine = _bank_engine(request)
     trace = request.get("trace")
     deadline = request.get("deadline")
@@ -914,7 +1023,7 @@ async def prediction(request: web.Request) -> web.Response:
         if engine is not None:
             result = await engine.score(
                 target,
-                X.values.astype("float32"),
+                Xf,
                 request_id=request.get("request_id"),
                 trace=trace,
                 deadline=deadline,
@@ -931,9 +1040,7 @@ async def prediction(request: web.Request) -> web.Response:
                 raise DeadlineExceeded("deadline expired before dispatch")
             loop = asyncio.get_running_loop()
             t0 = time.monotonic()
-            output = await loop.run_in_executor(
-                None, model.predict, X.values.astype("float32")
-            )
+            output = await loop.run_in_executor(None, model.predict, Xf)
             request["device_s"] = time.monotonic() - t0
             if trace is not None:
                 # per-model fallback path: no coalescing stages, but the
@@ -955,7 +1062,15 @@ async def prediction(request: web.Request) -> web.Response:
             text=json.dumps({"error": f"{type(exc).__name__}: {exc}"}),
             content_type="application/json",
         )
-    _note_scoring_result(request, target, X, output)
+    _note_scoring_result(request, target, Xf, output)
+    if encoding == "tensor":
+        # binary out for binary in: the output array is framed into one
+        # preallocated body — no tolist, no index stringification (the
+        # client trims its own index by the offset in __meta__)
+        return web.Response(
+            body=encode_prediction_response(output, len(Xf)),
+            content_type=TENSOR_CONTENT_TYPE,
+        )
     out_index = X.index[len(X) - len(output):]
     return web.json_response(
         {
@@ -975,34 +1090,51 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
         )
     _quarantine_gate(request)
     target = request.match_info["target"]
-    try:
-        X, y = await _parse_request(request)
-    except ValueError as exc:
-        raise web.HTTPBadRequest(
-            text=json.dumps({"error": str(exc)}), content_type="application/json"
-        )
+    encoding, X, y, Xf, yf = await _parse_scoring(request)
     engine = _bank_engine(request)
     trace = request.get("trace")
     deadline = request.get("deadline")
+    frame = None
     try:
         if engine is not None:
             result = await engine.score(
                 target,
-                X.values.astype("float32"),
-                None if y is None else y.values.astype("float32"),
+                Xf,
+                yf,
                 request_id=request.get("request_id"),
                 trace=trace,
                 deadline=deadline,
             )
             request["device_s"] = result.device_s
             t0 = time.monotonic()
-            frame = result.to_frame(index=X.index)
-            if trace is not None:
-                trace.add_span("postprocess", t0, time.monotonic(), stage="to_frame")
+            if encoding == "tensor":
+                # the banked fast path end-to-end: fetched device buffers
+                # -> ScoreResult arrays -> one preallocated response
+                # body. No DataFrame is ever constructed on this path.
+                body = encode_anomaly_response(
+                    result.tags, result.to_arrays(), result.offset
+                )
+                total_scaled = result.total_scaled
+                if trace is not None:
+                    trace.add_span(
+                        "postprocess", t0, time.monotonic(), stage="to_wire"
+                    )
+            else:
+                frame = result.to_frame(index=X.index)
+                if trace is not None:
+                    trace.add_span(
+                        "postprocess", t0, time.monotonic(), stage="to_frame"
+                    )
         else:
             if deadline is not None and deadline.expired():
                 _note_deadline_expired_per_model(request)
                 raise DeadlineExceeded("deadline expired before dispatch")
+            if X is None:
+                # per-model fallback wants DataFrames (model.anomaly's
+                # contract); tensor callers pay one cheap wrap here —
+                # the hot banked path above never does
+                X = pd.DataFrame(Xf)
+                y = None if yf is None else pd.DataFrame(yf)
             loop = asyncio.get_running_loop()
             t0 = time.monotonic()
             frame = await loop.run_in_executor(None, model.anomaly, X, y)
@@ -1011,6 +1143,12 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 trace.add_span(
                     "device_execute", t0, t0 + request["device_s"],
                     path="per-model",
+                )
+            if encoding == "tensor":
+                body = encode_anomaly_response(
+                    frame["model-input"].columns,
+                    anomaly_frame_arrays(frame),
+                    len(Xf) - len(frame),
                 )
     except EngineOverloaded as exc:
         raise _http_overloaded(exc)
@@ -1026,7 +1164,9 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     # NaN anywhere in the model's reconstruction propagates into the
     # total columns (sums of NaN), so the totals are a cheap O(rows)
     # whole-frame finiteness proxy for the breaker
-    _note_scoring_result(
-        request, target, X, frame[("total-anomaly-scaled", "")].to_numpy()
-    )
+    if frame is not None:
+        total_scaled = frame[("total-anomaly-scaled", "")].to_numpy()
+    _note_scoring_result(request, target, Xf, total_scaled)
+    if encoding == "tensor":
+        return web.Response(body=body, content_type=TENSOR_CONTENT_TYPE)
     return web.json_response(frame_to_dict(frame))

@@ -8,20 +8,34 @@ server decides what to advertise and the client decides what to send.
 """
 
 import functools
+from typing import Optional
 
 
 @functools.cache
-def parquet_engine_available() -> bool:
-    """True iff pandas can (de)serialize parquet here (pyarrow or
-    fastparquet importable)."""
+def parquet_engine() -> Optional[str]:
+    """The pandas parquet engine name ("pyarrow"/"fastparquet") or None.
+
+    Resolved ONCE and passed explicitly to every per-chunk
+    ``to_parquet``/``read_parquet`` call, skipping pandas' per-call
+    ``engine="auto"`` resolution (measured as a first-chunks cold-start
+    cost: ~2.4x on a cold process, noise once warm). The BENCH_r05
+    ``client_parquet_vs_json: 0.98`` regression itself root-caused to
+    the RESPONSE side staying JSON in both modes — see
+    docs/architecture.md "Wire protocol" for the measured split."""
     try:
         import pyarrow  # noqa: F401
 
-        return True
+        return "pyarrow"
     except ImportError:
         try:
             import fastparquet  # noqa: F401
 
-            return True
+            return "fastparquet"
         except ImportError:
-            return False
+            return None
+
+
+def parquet_engine_available() -> bool:
+    """True iff pandas can (de)serialize parquet here (pyarrow or
+    fastparquet importable)."""
+    return parquet_engine() is not None

@@ -541,11 +541,18 @@ def run_check(
                     pd.Timestamp("2020-01-01T00:00:00Z"),
                     pd.Timestamp("2020-01-02T10:00:00Z"),  # 204 rows @ 10min
                 )
-                return results, time.time() - t1, client._parquet_active
+                return (
+                    results,
+                    time.time() - t1,
+                    client._parquet_active,
+                    client._tensor_active,
+                )
             finally:
                 await runner.cleanup()
 
-        results, wall, parquet_active = asyncio.run(drive_client())
+        results, wall, parquet_active, tensor_active = asyncio.run(
+            drive_client()
+        )
     ok = [r for r in results if r.ok]
     rows = sum(len(r.predictions) for r in ok)
     out["client_backfill"] = {
@@ -557,6 +564,9 @@ def run_check(
         "rows": rows,
         "rows_per_sec": round(rows / max(1e-9, wall), 1),
         "parquet": bool(parquet_active),
+        # the negotiated data plane: True means the backfill rode the
+        # framed binary tensor format (architecture.md "Wire protocol")
+        "tensor": bool(tensor_active),
         "server_requests": dict(app["stats"]["requests"]),
         "peak_rss_mb": rss_mb(),  # client+server share this process: a
         # scale ceiling for the leg, not a pure client number
