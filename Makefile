@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -56,6 +56,16 @@ rebalance:
 # GORDO_STREAM=0 default-off contract (tests/test_streaming.py)
 stream:
 	$(PYTHON) -m pytest tests/ -q -m stream --continue-on-collection-errors
+
+# replay lane: the time-compressed backtest harness — the clock seam
+# (staleness/SLO/scrape aging on an injected timeline), duplicate-
+# delivery dedup, provider chunk-invariance, and every incident class
+# in replay/scenarios.py driven through the real ingest -> drift ->
+# recalibrate/refit -> hot-swap path at >=100x with verdict bounds
+# asserted (tests/test_replay.py; threshold/EWMA/refit knobs are tuned
+# against THIS lane, not vibes)
+replay:
+	$(PYTHON) -m pytest tests/ -q -m replay --continue-on-collection-errors
 
 # wire lane: the binary tensor data plane — frame codec round-trips
 # (dtype/shape/endianness, truncated/oversized/malformed -> 400 with
@@ -110,6 +120,13 @@ stream-demo:
 # prints rows/s + bytes/row side by side (tools/wire_demo.py)
 wire-demo:
 	$(PYTHON) tools/wire_demo.py
+
+# backtests the standard incident library through the real adaptive
+# loop at 100-1000x and prints the per-scenario verdict table +
+# one JSON doc (tools/replay_demo.py; bench.py's `replay` leg runs
+# the same tool)
+replay-demo:
+	$(PYTHON) tools/replay_demo.py
 
 bench:
 	$(PYTHON) bench.py

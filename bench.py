@@ -810,6 +810,81 @@ def bench_streaming(members=6, rows=96, epochs=3, mean_shift=4.0):
     }
 
 
+def bench_replay(epochs=3, speed=500.0):
+    """Time-compressed replay backtest (ISSUE 12) — the standard
+    incident library (mean shift, variance inflation, dropout,
+    flatline, late+duplicate delivery, seasonal cycle, correlated
+    fleet failure, refit-fault co-fire) driven through the real
+    ingest -> drift -> recalibrate/refit -> hot-swap path on a
+    ReplayClock. Records per-incident-class detection latency, FP/FN
+    before/after adaptation, adaptation cost, and the achieved
+    compression. Subprocess (env knobs land before server import) via
+    tools/replay_demo.py."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "replay_demo.py"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, tool, "--epochs", str(epochs),
+            "--speed", str(speed), "--platform", "cpu",
+        ],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"replay demo failed: {' | '.join(tail[-3:])}")
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    assert doc["passed"], {
+        k: v["failures"] for k, v in doc["scenarios"].items() if v["failures"]
+    }
+    assert doc["total_non_200"] == 0, doc["total_non_200"]
+    assert doc["min_speedup"] >= 100.0, doc["min_speedup"]
+    ms = doc["scenarios"]["mean_shift"]
+    # PR 9 parity, replayed: the post-adaptation FP rate collapses
+    fp_before = max(ms["fp_rate_before"].values())
+    fp_after = max(ms["fp_rate_after"].values())
+    assert fp_after == 0.0 or fp_before / fp_after >= 2.0, (fp_before, fp_after)
+    detection = {
+        name: min(
+            (
+                e["detection_latency_s"]
+                for e in v["incidents"].values()
+                if e["detected"]
+            ),
+            default=None,
+        )
+        for name, v in doc["scenarios"].items()
+    }
+    return {
+        "replay_scenarios": len(doc["scenarios"]),
+        "replay_min_speedup": doc["min_speedup"],
+        "replay_non200_total": doc["total_non_200"],
+        "replay_mean_shift_detection_s": detection["mean_shift"],
+        "replay_mean_shift_fp_before": fp_before,
+        "replay_mean_shift_fp_after": fp_after,
+        "replay_adaptation_cost_s": round(
+            sum(v["adaptation_cost_s"] for v in doc["scenarios"].values()), 3
+        ),
+        "replay_refit_s": round(
+            sum(v["refit_s"] for v in doc["scenarios"].values()), 3
+        ),
+        "replay_swap_pause_ms_max": max(
+            v["swap_pause_ms_max"] for v in doc["scenarios"].values()
+        ),
+        "replay_rolled_back": sum(
+            v["rolled_back"] for v in doc["scenarios"].values()
+        ),
+        "replay_duplicates_absorbed": sum(
+            v["duplicate_rows_total"] for v in doc["scenarios"].values()
+        ),
+        "replay_detection_latency_s": detection,
+        "replay": doc,
+    }
+
+
 def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
     """Config 5 extension — sequence models served from the HBM bank
     (windowing runs in-graph with the bucket's static lookback)."""
@@ -1346,6 +1421,7 @@ METRICS = (
     ("bank_sequence", bench_bank_sequence),
     ("rebalance", bench_rebalance),
     ("streaming", bench_streaming),
+    ("replay", bench_replay),
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
@@ -1373,6 +1449,7 @@ CPU_KWARGS = {
     "bank_sequence": dict(n_models=8, iters=5),
     "rebalance": dict(members=64, request_rows=32),
     "streaming": dict(members=4, rows=64, epochs=2),
+    "replay": dict(epochs=2),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
     # the full 10k leg takes ~2.5 min on one core (measured; most of it

@@ -1,0 +1,124 @@
+"""The clock seam: one injectable object behind every wall-time read in
+the adaptive loop.
+
+The replay engine compresses months of event time into seconds of wall
+time. That only works if the components whose SEMANTICS are defined in
+wall time — watermark lateness, data staleness, SLO window ages, scrape
+freshness, adapt-loop cadence — read "now" from the same timeline the
+replayed event stamps live on. Otherwise a replayed row stamped three
+weeks ago is instantly "late beyond the allowance", every buffer reads
+"stale for 21 days", and the backtest exercises none of the logic it
+exists to validate.
+
+Two implementations of one tiny interface:
+
+- :class:`SystemClock` — delegates to ``time.time``/``time.monotonic``.
+  The module-level :data:`SYSTEM_CLOCK` instance is the default
+  everywhere; with replay off, call sites read the real clock through
+  one extra attribute lookup (held to the existing <=5% hot-loop
+  guards).
+- :class:`ReplayClock` — a virtual timeline STEPPED by the replay
+  engine (``advance_to``), never free-running: a replay run is
+  deterministic because time only moves when the engine says so.
+  ``timescale`` records the nominal compression factor so cadence-based
+  consumers (the adapt auto-loop sleep) can compress their real sleeps
+  to match.
+
+The seam rule (docs/architecture.md "Replay & backtesting"): quantities
+that measure *how long work actually took* — refit seconds, swap pause,
+drift-sweep duration, goodput device/wall attribution — never read this
+clock; they are real costs and stay on the real ``time.monotonic``.
+Quantities that measure *freshness or age of data/events* read the
+seam.
+"""
+
+import threading
+import time
+
+__all__ = ["Clock", "ReplayClock", "SystemClock", "SYSTEM_CLOCK"]
+
+
+class Clock:
+    """The seam interface. ``time()`` is epoch seconds (event/wall
+    timeline), ``monotonic()`` a monotonic seconds source on the SAME
+    timeline (window aging, cadence checks). ``timescale`` is the
+    nominal event-seconds-per-wall-second compression (1.0 = real
+    time)."""
+
+    timescale = 1.0
+
+    def time(self) -> float:
+        raise NotImplementedError
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+
+_real_time, _real_monotonic = time.time, time.monotonic
+
+
+class SystemClock(Clock):
+    """Real time. The process-wide default (:data:`SYSTEM_CLOCK`)."""
+
+    # bound straight to the C clock functions: reading the seam with
+    # replay off costs one attribute lookup over calling time.time()
+    time = staticmethod(_real_time)
+    monotonic = staticmethod(_real_monotonic)
+
+
+SYSTEM_CLOCK = SystemClock()
+
+
+class ReplayClock(Clock):
+    """A stepped virtual timeline for time-compressed replay.
+
+    The engine anchors it at the replayed history's start
+    (``start_epoch``) and advances it to each batch's high event stamp
+    (:meth:`advance_to`) as the batch lands. Components reading the
+    seam then see "now" sit just past the freshest event — exactly the
+    relationship a live stream has with the real clock — regardless of
+    how fast the wall clock is burning.
+
+    ``monotonic()`` is a virtual monotonic source that starts at an
+    arbitrary positive offset (mirroring the real ``time.monotonic``
+    contract: only differences are meaningful) and advances with the
+    virtual epoch. Stepping backwards is a no-op for ``monotonic`` and
+    an error for ``advance_to`` — replayed time, like real time, never
+    rewinds.
+
+    Thread-safe: the engine advances from the event loop while drift
+    sweeps and SLO samples read from executor threads.
+    """
+
+    def __init__(self, start_epoch: float, speed: float = 100.0):
+        if speed <= 0:
+            raise ValueError(f"speed must be positive, got {speed!r}")
+        self._epoch = float(start_epoch)
+        self._mono = 1000.0  # arbitrary positive origin, like the real one
+        self.timescale = float(speed)
+        self._lock = threading.Lock()
+
+    def time(self) -> float:
+        return self._epoch
+
+    def monotonic(self) -> float:
+        return self._mono
+
+    def advance(self, dt_s: float) -> float:
+        """Step the virtual timeline forward ``dt_s`` event seconds."""
+        if dt_s < 0:
+            raise ValueError(f"cannot advance by negative {dt_s!r}s")
+        with self._lock:
+            self._epoch += dt_s
+            self._mono += dt_s
+            return self._epoch
+
+    def advance_to(self, epoch_s: float) -> float:
+        """Step the virtual epoch to ``epoch_s`` (no-op when already
+        past it — batches may share a high stamp)."""
+        with self._lock:
+            dt = float(epoch_s) - self._epoch
+            if dt > 0:
+                self._epoch += dt
+                self._mono += dt
+            return self._epoch

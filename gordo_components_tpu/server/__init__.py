@@ -320,6 +320,7 @@ def build_app(
     arena_max_mb: Optional[float] = None,
     bank_dtype: Optional[str] = None,
     bank_kernel: Optional[str] = None,
+    clock=None,
 ) -> web.Application:
     """App factory: loads the artifact(s) under ``model_dir`` once.
 
@@ -341,6 +342,12 @@ def build_app(
     padded-buffer arena. ``GORDO_COMPILE_CACHE_DIR`` arms the persistent
     XLA compilation cache before the bank's bucket programs build, so a
     restarted replica re-warms from disk instead of recompiling.
+
+    ``clock`` is the wall-time seam (replay/clock.py): the streaming
+    plane's lateness/staleness accounting and the SLO tracker's window
+    aging read it, so the replay harness can compress event time
+    without distorting their semantics. Default (None) is the real
+    clock — production never passes this.
     """
     def env_int(
         name: str, default: Optional[str] = None, hint: str = ""
@@ -403,6 +410,12 @@ def build_app(
     app = web.Application(
         client_max_size=256 * 1024**2, middlewares=[_stats_middleware]
     )
+    # the wall-time seam: every component whose semantics are defined in
+    # wall time (streaming lateness/staleness, SLO windows) reads THIS
+    # clock, so replay can swap in a compressed timeline app-wide
+    from gordo_components_tpu.replay.clock import SYSTEM_CLOCK
+
+    app["clock"] = clock if clock is not None else SYSTEM_CLOCK
     app["stats"] = {
         "started_at": time.time(),
         "requests": {},
@@ -443,7 +456,11 @@ def build_app(
     ledger = GoodputLedger.from_env(registry)
     app["goodput"] = ledger
     if ledger is not None:
-        app["slo"] = SLOTracker(ledger, registry=registry)
+        # SLO window ages ride the seam: under replay a "5m" burn
+        # window spans 5 replayed minutes, not 5 real ones
+        app["slo"] = SLOTracker(
+            ledger, registry=registry, clock=app["clock"].monotonic
+        )
     collection = ModelCollection(model_dir, target_name=target_name)
     app["collection"] = collection
     # per-model scoring-failure breaker (resilience/quarantine.py): a
@@ -574,8 +591,14 @@ def build_app(
             tracker.sample(force=True)  # boot baseline sample
 
             async def _tick():
+                # cadence in seam seconds: a replay clock compresses
+                # the real sleep so samples land every
+                # sample_interval_s of REPLAYED time
+                real_sleep = tracker.sample_interval_s / max(
+                    1.0, app["clock"].timescale
+                )
                 while True:
-                    await asyncio.sleep(tracker.sample_interval_s)
+                    await asyncio.sleep(real_sleep)
                     tracker.sample()
 
             app["slo_sampler"] = asyncio.get_running_loop().create_task(_tick())

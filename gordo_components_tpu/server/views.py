@@ -808,7 +808,9 @@ async def ingest_rows(request: web.Request) -> web.Response:
             values = rows_as_f32(frames["rows"], "rows")
             ts = frames.get("timestamps")
             if ts is None:
-                event_ts = np.full((len(values),), time.time())
+                # "arrived now" on the plane's clock seam: under replay
+                # this is the replayed now, not the compressing wall
+                event_ts = np.full((len(values),), plane.clock.time())
             else:
                 event_ts = np.asarray(ts, np.float64).reshape(-1)
                 if len(event_ts) != len(values):
@@ -842,7 +844,7 @@ async def ingest_rows(request: web.Request) -> web.Response:
         )
         raw_ts = body.get("timestamps")
         if raw_ts is None:
-            event_ts = np.full((len(values),), time.time())
+            event_ts = np.full((len(values),), plane.clock.time())
         elif not isinstance(raw_ts, list):
             raise ValueError("timestamps must be a list")
         elif len(raw_ts) != len(values):

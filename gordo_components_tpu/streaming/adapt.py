@@ -56,10 +56,18 @@ class StreamingPlane:
     loop, and the ``gordo_stream_*`` / ``gordo_drift_*`` metric surface."""
 
     def __init__(self, app):
+        from gordo_components_tpu.replay.clock import SYSTEM_CLOCK
+
         self.app = app
+        # the clock seam (replay/clock.py): build_app stores the
+        # process clock under app["clock"]; replay injects a
+        # ReplayClock there so lateness/staleness/cadence age on the
+        # replayed timeline. Default: the real clock.
+        self.clock = app.get("clock") or SYSTEM_CLOCK
         self.ingestor = StreamIngestor(
             capacity=_env_num("GORDO_STREAM_WINDOW", 512, int),
             lateness_s=_env_num("GORDO_STREAM_LATENESS_S", 300.0, float),
+            clock=self.clock,
         )
         self.detector = DriftDetector(
             app,
@@ -115,10 +123,15 @@ class StreamingPlane:
             totals["dropped_rows_total"],
         )
         yield (
+            "gordo_stream_duplicate_rows_total", "counter",
+            "Exact (timestamp, row) re-sends deduplicated at ingest",
+            {}, totals["duplicate_rows_total"],
+        )
+        yield (
             "gordo_stream_members", "gauge",
             "Members with live window buffers", {}, totals["buffers"],
         )
-        now = time.time()
+        now = self.clock.time()
         lag = self.ingestor.max_watermark_lag_s(now)
         if lag is not None:
             yield (
@@ -233,7 +246,7 @@ class StreamingPlane:
             swap_info = None
             collection.publish(
                 updates,
-                note={"adapted": mode, "at": time.time()},
+                note={"adapted": mode, "at": self.clock.time()},
             )
             if app.get("bank_enabled"):
                 from gordo_components_tpu.placement.swap import (
@@ -448,7 +461,13 @@ class StreamingPlane:
 
     async def _run(self) -> None:
         while True:
-            await asyncio.sleep(self.interval_s)
+            # the interval is defined in EVENT seconds: under a replay
+            # clock (timescale = compression factor) the real sleep
+            # shrinks so the loop keeps its cadence on the replayed
+            # timeline; timescale is 1.0 on the real clock
+            await asyncio.sleep(
+                self.interval_s / max(1.0, self.clock.timescale)
+            )
             try:
                 await self.evaluate()
                 drifted = self.detector.drifted_members()

@@ -21,6 +21,12 @@ different operator question:
   reads high on healthy data — the drift VERDICT never depends on it
   (it is error-ratio-based), so treat ``input_oob`` as a delta-over-
   baseline signal there, not an absolute.
+- **flatline** (``flatline_tags``): scaled-input channels whose window
+  standard deviation collapsed to ~0 — a sensor stuck at its last value
+  LOOKS alive and reconstructs well (the autoencoder happily copies a
+  constant), so reconstruction error never flags it; the variance
+  collapse is the only cheap signal that does. A flatlined channel
+  marks the member drifted: its model is scoring on dead input.
 - **staleness** (``staleness_seconds``): seconds since fresh rows last
   arrived — a model scoring live traffic on week-old calibration is
   burning device time on answers nobody can trust.
@@ -30,6 +36,13 @@ banked (the same math the serving path uses, so drift is measured in the
 units the operator already watches), falling back to the per-model path
 otherwise. Evaluation is blocking (device work) — the adaptation plane
 runs it in an executor, never on the event loop.
+
+Clock seam (replay/clock.py): freshness quantities — ``last_eval_wall``,
+the staleness the view reports — read the ingestor's injectable clock so
+time-compressed replay ages them on the replayed timeline. Sweep
+DURATIONS (``last_eval_s``, span timings) stay on the real
+``time.monotonic``: they measure actual device/host cost, which replay
+must report honestly, not compress.
 """
 
 import logging
@@ -45,19 +58,25 @@ logger = logging.getLogger(__name__)
 # margin absorbs resampling/noise wobble so healthy streams read ~0
 _OOB_MARGIN = 0.05
 
+# a scaled channel whose window std sits below this is flat: training
+# data maps into [0, 1] (std O(0.1+)), and even a quiet-but-alive sensor
+# keeps its noise floor; an exactly-held value reads 0.0
+_FLATLINE_STD = 1e-4
+
 
 class MemberDrift:
     """Rolling drift state for one member."""
 
     __slots__ = (
-        "ewma_total", "drift_score", "input_oob", "rows_scored",
-        "last_eval_wall", "drifted", "error",
+        "ewma_total", "drift_score", "input_oob", "flatline_tags",
+        "rows_scored", "last_eval_wall", "drifted", "error",
     )
 
     def __init__(self):
         self.ewma_total: Optional[float] = None
         self.drift_score: Optional[float] = None
         self.input_oob: Optional[float] = None
+        self.flatline_tags = 0
         self.rows_scored = 0
         self.last_eval_wall: Optional[float] = None
         self.drifted = False
@@ -68,6 +87,7 @@ class MemberDrift:
             "drift_score": _round(self.drift_score),
             "ewma_total_scaled": _round(self.ewma_total),
             "input_oob_fraction": _round(self.input_oob),
+            "flatline_tags": self.flatline_tags,
             "rows_scored": self.rows_scored,
             "drifted": self.drifted,
         }
@@ -94,6 +114,7 @@ class DriftDetector:
     ):
         self.app = app
         self.ingestor = ingestor
+        self.clock = ingestor.clock  # the shared seam (replay/clock.py)
         self.threshold = float(threshold)
         self.alpha = float(alpha)  # EWMA weight of the NEWEST window
         self.min_rows = int(min_rows)
@@ -146,10 +167,10 @@ class DriftDetector:
                 logger.warning("drift scoring failed for %r", name, exc_info=True)
                 continue
             st.rows_scored += len(X)
-            st.last_eval_wall = time.time()
+            st.last_eval_wall = self.clock.time()
             st.drifted = (
                 st.drift_score is not None and st.drift_score > self.threshold
-            )
+            ) or st.flatline_tags > 0
             if st.drifted:
                 drifted.append(name)
                 if trace is not None:
@@ -161,7 +182,7 @@ class DriftDetector:
                         rows=len(X),
                     )
         self.evaluations += 1
-        self.last_eval_wall = time.time()
+        self.last_eval_wall = self.clock.time()
         self.last_eval_s = time.monotonic() - t0
         if trace is not None:
             trace.finish(
@@ -196,6 +217,13 @@ class DriftDetector:
                     (scaled_in < -_OOB_MARGIN) | (scaled_in > 1.0 + _OOB_MARGIN)
                 )
             )
+            # variance collapse: a stuck-at-value sensor reconstructs
+            # fine (error stays low) — the collapsed window std is the
+            # signal that flags it
+            if scaled_in.shape[0] >= 8:
+                st.flatline_tags = int(
+                    (np.nanstd(scaled_in, axis=0) < _FLATLINE_STD).sum()
+                )
 
     @staticmethod
     def _scaled_inputs_banked(bank, name: str, X) -> Optional[np.ndarray]:
@@ -219,7 +247,7 @@ class DriftDetector:
         return sorted(n for n, st in self.members.items() if st.drifted)
 
     def view(self) -> Dict[str, Any]:
-        now = time.time()
+        now = self.clock.time()
         members = {}
         for name, buf in sorted(self.ingestor.buffers.items()):
             entry: Dict[str, Any] = {
@@ -227,6 +255,7 @@ class DriftDetector:
                 "rows_total": buf.rows_total,
                 "late_rows": buf.late_rows,
                 "dropped_rows": buf.dropped_rows,
+                "duplicate_rows": buf.duplicate_rows,
                 "dropout_cells": buf.dropout_cells,
                 "watermark_lag_seconds": _round(buf.watermark_lag_s(now), 1),
                 "staleness_seconds": _round(buf.staleness_s(now), 1),

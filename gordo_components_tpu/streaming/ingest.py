@@ -13,19 +13,31 @@ for the stream's failure modes instead of assuming them away:
   yesterday's distribution;
 - **sensor dropout** (NaN cells) is masked and counted; rows with any
   missing sensor are excluded from scoring/refit windows (the same
-  dropna contract the training datasets apply).
+  dropna contract the training datasets apply);
+- **duplicated delivery** (a gateway re-sending rows it already
+  delivered — at-least-once transports do this on every reconnect) is
+  deduplicated by EXACT ``(timestamp, row)`` match against the buffered
+  window and counted (``gordo_stream_duplicate_rows_total``) instead of
+  double-filling the window: a window where half the rows are one
+  repeated sample would drag the drift EWMA toward that sample and
+  mis-teach recalibration. Rows that share a timestamp but carry
+  DIFFERENT values (two sensors legitimately sampled in the same
+  second, or a corrected re-send) are not duplicates and are kept.
 
 Ingestion is host-side numpy on the event loop (bounded by the request
 body size) and never touches the scoring hot path; the ``stream.ingest``
-faultpoint makes the endpoint a chaos target.
+faultpoint makes the endpoint a chaos target. Wall-clock reads
+(arrival stamps, staleness) go through the injectable clock seam
+(``replay/clock.py``) so time-compressed replay drives the same code;
+the default is the real clock and costs one attribute read.
 """
 
 import threading
-import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from gordo_components_tpu.replay.clock import SYSTEM_CLOCK
 from gordo_components_tpu.resilience.faults import faultpoint
 
 # chaos site (tests/test_streaming.py): fired per ingest call, BEFORE any
@@ -40,13 +52,17 @@ class WindowBuffer:
     __slots__ = (
         "capacity", "n_features", "lateness_s", "_values", "_ts", "_n",
         "_head", "watermark", "rows_total", "late_rows", "dropped_rows",
-        "dropout_cells", "last_ingest_wall", "_lock",
+        "duplicate_rows", "dropout_cells", "last_ingest_wall", "_lock",
+        "clock",
     )
 
-    def __init__(self, capacity: int, n_features: int, lateness_s: float):
+    def __init__(
+        self, capacity: int, n_features: int, lateness_s: float, clock=None
+    ):
         self.capacity = int(capacity)
         self.n_features = int(n_features)
         self.lateness_s = float(lateness_s)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._values = np.empty((self.capacity, self.n_features), np.float32)
         self._ts = np.empty((self.capacity,), np.float64)
         self._n = 0  # valid rows in the ring
@@ -55,6 +71,7 @@ class WindowBuffer:
         self.rows_total = 0  # accepted rows
         self.late_rows = 0  # rows behind the watermark at arrival
         self.dropped_rows = 0  # late beyond the allowed lateness
+        self.duplicate_rows = 0  # exact (ts, row) re-sends, deduplicated
         self.dropout_cells = 0  # NaN sensor cells accepted
         self.last_ingest_wall = None  # wall clock of the last accept
         # ingest runs on the event loop; drift evaluation reads windows
@@ -86,12 +103,20 @@ class WindowBuffer:
         keep = ~too_late
         n_keep = int(keep.sum())
         overflow = 0
+        n_dup = 0
         with self._lock:
             self.late_rows += int(behind.sum())
             self.dropped_rows += int(too_late.sum())
             if n_keep:
                 kept_v = values[keep]
                 kept_t = event_ts[keep]
+                dup = self._find_duplicates(kept_t, kept_v)
+                if dup is not None:
+                    n_dup = int(dup.sum())
+                    self.duplicate_rows += n_dup
+                    kept_v, kept_t = kept_v[~dup], kept_t[~dup]
+                    n_keep -= n_dup
+            if n_keep:
                 if n_keep > self.capacity:
                     # a batch larger than the ring keeps only the
                     # freshest rows BY EVENT TIME (arrival order could
@@ -118,7 +143,7 @@ class WindowBuffer:
                 self._head = end % self.capacity
                 self._n = min(self.capacity, self._n + n_keep)
                 self.rows_total += n_keep
-                self.last_ingest_wall = time.time()
+                self.last_ingest_wall = self.clock.time()
             if len(event_ts):
                 high = float(event_ts.max())
                 if self.watermark is None or high > self.watermark:
@@ -127,7 +152,34 @@ class WindowBuffer:
             "accepted": n_keep,
             "late": int(behind.sum()),
             "dropped": int(too_late.sum()) + overflow,
+            "duplicates": n_dup,
         }
+
+    def _find_duplicates(self, kept_t, kept_v) -> Optional[np.ndarray]:
+        """Mask of exact ``(timestamp, row)`` re-sends among the rows
+        about to be accepted — against the buffered window AND within
+        the batch itself. Called under the lock. Healthy streams
+        (advancing stamps, unique within the batch) exit after two
+        vectorized checks with no per-row work; ``None`` means "no
+        duplicates" without allocating the mask."""
+        ring_ts = self._ts[: self._n]
+        hits_ring = self._n > 0 and bool(np.isin(kept_t, ring_ts).any())
+        if not hits_ring and len(np.unique(kept_t)) == len(kept_t):
+            return None
+        # NaN dropout cells compare via the row's BYTES, so an exact
+        # re-send matches even though NaN != NaN elementwise
+        seen = set()
+        if hits_ring:
+            for i in np.flatnonzero(np.isin(ring_ts, kept_t)):
+                seen.add((float(ring_ts[i]), self._values[i].tobytes()))
+        dup = np.zeros(len(kept_t), bool)
+        for j in range(len(kept_t)):
+            key = (float(kept_t[j]), kept_v[j].tobytes())
+            if key in seen:
+                dup[j] = True
+            else:
+                seen.add(key)
+        return dup
 
     def window(self) -> Tuple[np.ndarray, np.ndarray]:
         """The buffered rows in EVENT-TIME order (copies): ``(ts, values)``.
@@ -157,7 +209,9 @@ class WindowBuffer:
         high-water mark sits."""
         if self.watermark is None:
             return None
-        return max(0.0, (now if now is not None else time.time()) - self.watermark)
+        return max(
+            0.0, (now if now is not None else self.clock.time()) - self.watermark
+        )
 
     def staleness_s(self, now: Optional[float] = None) -> Optional[float]:
         """Seconds since fresh data last ARRIVED (wall clock) — the
@@ -165,16 +219,21 @@ class WindowBuffer:
         if self.last_ingest_wall is None:
             return None
         return max(
-            0.0, (now if now is not None else time.time()) - self.last_ingest_wall
+            0.0,
+            (now if now is not None else self.clock.time())
+            - self.last_ingest_wall,
         )
 
 
 class StreamIngestor:
     """Per-member :class:`WindowBuffer` registry behind ``POST /ingest``."""
 
-    def __init__(self, capacity: int = 512, lateness_s: float = 300.0):
+    def __init__(
+        self, capacity: int = 512, lateness_s: float = 300.0, clock=None
+    ):
         self.capacity = int(capacity)
         self.lateness_s = float(lateness_s)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.buffers: Dict[str, WindowBuffer] = {}
 
     def ingest(
@@ -187,7 +246,8 @@ class StreamIngestor:
         buf = self.buffers.get(name)
         if buf is None:
             buf = self.buffers[name] = WindowBuffer(
-                self.capacity, values.shape[1], self.lateness_s
+                self.capacity, values.shape[1], self.lateness_s,
+                clock=self.clock,
             )
         out = buf.add(event_ts, values)
         out["window_rows"] = len(buf)
@@ -202,6 +262,7 @@ class StreamIngestor:
             "rows_total": sum(b.rows_total for b in bufs),
             "late_rows_total": sum(b.late_rows for b in bufs),
             "dropped_rows_total": sum(b.dropped_rows for b in bufs),
+            "duplicate_rows_total": sum(b.duplicate_rows for b in bufs),
             "dropout_cells_total": sum(b.dropout_cells for b in bufs),
             "buffers": len(bufs),
         }

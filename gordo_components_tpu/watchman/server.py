@@ -18,7 +18,6 @@ the collection doesn't know). Watchman discovers targets from ``GET
 import asyncio
 import logging
 import math
-import time
 from typing import Any, Dict, List, Optional
 
 import aiohttp
@@ -30,6 +29,7 @@ from gordo_components_tpu.observability import (
     parse_prometheus_text,
     render_samples,
 )
+from gordo_components_tpu.replay.clock import SYSTEM_CLOCK
 from gordo_components_tpu.resilience.deadline import Deadline
 from gordo_components_tpu.resilience.faults import faultpoint
 
@@ -150,7 +150,9 @@ def aggregate_fleet_metrics(
     }
 
 
-def render_fleet_metrics(agg: Dict[str, Any]) -> str:
+def render_fleet_metrics(
+    agg: Dict[str, Any], now_mono: Optional[float] = None
+) -> str:
     """Aggregated rollup as Prometheus text: computed fleet gauges first,
     then the scraped series under their original names (federation-style,
     replica label collapsed). Counters and histogram samples sum across
@@ -182,7 +184,10 @@ def render_fleet_metrics(agg: Dict[str, Any]) -> str:
     # shows up via replicas_scraped, so it gets no sample here
     last_success = agg.get("replica_last_success") or []
     if any(ts is not None for ts in last_success):
-        now_mono = time.monotonic()
+        # staleness ages on the caller's clock seam (replay compresses
+        # it with everything else); bare calls read the real clock
+        if now_mono is None:
+            now_mono = SYSTEM_CLOCK.monotonic()
         types["gordo_fleet_scrape_stale_seconds"] = "gauge"
         for i, ts in enumerate(last_success):
             if ts is None:
@@ -229,9 +234,13 @@ class WatchmanState:
         gang_stale_after: float = 120.0,
         full_metadata: bool = False,
         metrics_urls: Optional[List[str]] = None,
+        clock=None,
     ):
         self.project = project
         self.base_url = base_url.rstrip("/")
+        # wall-time seam (replay/clock.py): cache ages + scrape
+        # staleness read it; default is the real clock
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.targets = targets
         self.refresh_interval = refresh_interval
         self.parallelism = parallelism
@@ -372,14 +381,14 @@ class WatchmanState:
         if not wait:
             if (
                 self._metrics_cache is None
-                or time.monotonic() - self._metrics_time >= self.refresh_interval
+                or self.clock.monotonic() - self._metrics_time >= self.refresh_interval
             ) and (self._metrics_task is None or self._metrics_task.done()):
                 self._metrics_task = asyncio.get_running_loop().create_task(
                     self.fleet_metrics()
                 )
             return self._metrics_cache
         async with self._metrics_lock:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if (
                 self._metrics_cache is not None
                 and now - self._metrics_time < self.refresh_interval
@@ -417,7 +426,7 @@ class WatchmanState:
             live_count = sum(1 for t in texts if t is not None)
             # per-replica freshness BEFORE the last-good substitution: a
             # replica serving frozen numbers is stale, not live
-            mono = time.monotonic()
+            mono = self.clock.monotonic()
             succ = self._metrics_last_success
             succ.extend([None] * (len(texts) - len(succ)))
             for i, t in enumerate(texts):
@@ -518,14 +527,14 @@ class WatchmanState:
         if not wait:
             if (
                 self._drift_cache is None
-                or time.monotonic() - self._drift_time >= self.refresh_interval
+                or self.clock.monotonic() - self._drift_time >= self.refresh_interval
             ) and (self._drift_task is None or self._drift_task.done()):
                 self._drift_task = asyncio.get_running_loop().create_task(
                     self.fleet_drift()
                 )
             return self._drift_cache
         async with self._drift_lock:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if (
                 not refresh
                 and self._drift_cache is not None
@@ -607,7 +616,7 @@ class WatchmanState:
                 ),
             }
             self._drift_cache = rollup
-            self._drift_time = time.monotonic()
+            self._drift_time = self.clock.monotonic()
             return rollup
 
     async def fleet_rebalance(
@@ -766,7 +775,7 @@ class WatchmanState:
 
     async def snapshot(self) -> Dict[str, Any]:
         async with self._lock:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if self._cache is not None and now - self._cache_time < self.refresh_interval:
                 return self._cache
             try:
@@ -955,11 +964,12 @@ def build_watchman_app(
     gang_state_dir: Optional[str] = None,
     full_metadata: bool = False,
     metrics_urls: Optional[List[str]] = None,
+    clock=None,
 ) -> web.Application:
     state = WatchmanState(
         project, base_url, targets, refresh_interval,
         gang_state_dir=gang_state_dir, full_metadata=full_metadata,
-        metrics_urls=metrics_urls,
+        metrics_urls=metrics_urls, clock=clock,
     )
     app = web.Application()
     app["state"] = state
@@ -987,7 +997,7 @@ def build_watchman_app(
                 # live per-replica scrape age: ~0 = fresh, large = the
                 # rollup is carrying this replica's last-good numbers
                 "scrape_stale_seconds": {
-                    str(i): round(max(0.0, time.monotonic() - ts), 1)
+                    str(i): round(max(0.0, state.clock.monotonic() - ts), 1)
                     for i, ts in enumerate(last_success)
                     if ts is not None
                 },
@@ -1032,7 +1042,9 @@ def build_watchman_app(
         if agg is None:  # lost the first-scrape race: render an empty rollup
             agg = aggregate_fleet_metrics([])
         return web.Response(
-            body=render_fleet_metrics(agg).encode("utf-8"),
+            body=render_fleet_metrics(
+                agg, now_mono=state.clock.monotonic()
+            ).encode("utf-8"),
             headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
         )
 

@@ -128,20 +128,20 @@ async def _ingest(client, name, ts, vals, stamp):
 def test_window_buffer_ring_watermark_and_accounting():
     buf = WindowBuffer(capacity=8, n_features=2, lateness_s=10.0)
     out = buf.add(np.arange(5.0) + 100, np.ones((5, 2), np.float32))
-    assert out == {"accepted": 5, "late": 0, "dropped": 0}
+    assert out == {"accepted": 5, "late": 0, "dropped": 0, "duplicates": 0}
     assert buf.watermark == 104.0 and len(buf) == 5
     # out-of-order within the allowance: accepted, counted late
     out = buf.add(np.array([101.5]), np.full((1, 2), 7.0, np.float32))
-    assert out == {"accepted": 1, "late": 1, "dropped": 0}
+    assert out == {"accepted": 1, "late": 1, "dropped": 0, "duplicates": 0}
     # beyond the allowance: counted AND dropped
     out = buf.add(np.array([50.0]), np.zeros((1, 2), np.float32))
-    assert out == {"accepted": 0, "late": 1, "dropped": 1}
+    assert out == {"accepted": 0, "late": 1, "dropped": 1, "duplicates": 0}
     assert buf.late_rows == 2 and buf.dropped_rows == 1
     # ring wraps: only the freshest `capacity` rows remain, time-ordered,
     # and the overflow is accounted as dropped — every posted row lands
     # in exactly one counter (accepted + dropped == rows posted)
     out = buf.add(np.arange(10.0) + 110, np.zeros((10, 2), np.float32))
-    assert out == {"accepted": 8, "late": 0, "dropped": 2}
+    assert out == {"accepted": 8, "late": 0, "dropped": 2, "duplicates": 0}
     ts, vals = buf.window()
     assert len(ts) == 8 and (np.diff(ts) >= 0).all()
     assert ts[-1] == 119.0
@@ -208,10 +208,15 @@ def test_provider_deterministic_and_drift_injectable():
     assert np.isnan(v4).sum() > 0
     assert (np.diff(ts4) < 0).any()  # out-of-order arrival
     np.testing.assert_array_equal(np.sort(ts4), ts1)  # same event times
-    # variance inflation
+    # variance inflation scales the NOISE around the clean signal (the
+    # chunk-invariant definition): the residual vs the noise-free
+    # provider inflates by ~sqrt(k), the signal itself is untouched
+    clean = SimulatedLiveProvider(freq="10s", noise=0.0, seed=5)
+    _, vc = clean.batch(T_LIVE, 64, TAGS3)
     a.inject(var_inflation=9.0)
     _, v5 = a.batch(T_LIVE, 64, TAGS3)
-    assert np.nanstd(v5) > 2.0 * np.nanstd(v1)
+    r = np.nanstd(v5 - vc) / np.nanstd(v1 - vc)
+    assert 2.5 < r < 3.5, r
     # the training-side view (load_series) stays healthy under injection:
     # drift is a property of the live stream, never of the training range
     from gordo_components_tpu.dataset.sensor_tag import normalize_sensor_tags
