@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -76,6 +76,16 @@ replay:
 wire:
 	$(PYTHON) -m pytest tests/ -q -m wire --continue-on-collection-errors
 
+# saturation lane: the serving-plane saturation stack — multi-worker
+# pool (shared state, per-worker engines, SO_REUSEPORT + acceptor
+# fallback, cross-loop reload), the uds/shm zero-copy transports with
+# cross-transport bitwise parity + the shm error surface, the client's
+# transport negotiation ladder with graceful tcp fallback, and push
+# mode's long-poll/backpressure/default-off contracts
+# (tests/test_saturate.py + the parity legs in tests/test_wire.py)
+saturate:
+	$(PYTHON) -m pytest tests/ -q -m saturate --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -87,10 +97,12 @@ hotloop:
 # serial parity+no-slower check (tests/test_bank_pipeline.py) PLUS the
 # banked-kernel legs (tests/test_banked_kernel.py parity sweep and
 # tests/test_bank_quantized.py fused-kernel>=XLA-at-equal-dtype) PLUS
-# the tensor-path>=JSON-path wire guard (tests/test_wire.py) — the
-# scoring pipeline must never regress below the serial path it replaced,
-# the fused kernel below the XLA epilogue, or the binary data plane
-# below the JSON path it bypasses
+# the tensor-path>=JSON-path wire guard (tests/test_wire.py) PLUS the
+# saturation guards (tests/test_saturate.py: multi-worker >= single
+# under mixed load, uds >= tcp) — the scoring pipeline must never
+# regress below the serial path it replaced, the fused kernel below the
+# XLA epilogue, the binary data plane below the JSON path it bypasses,
+# or the local transports below the TCP stack they bypass
 perf-guard:
 	$(PYTHON) -m pytest tests/ -q -m "hotloop or perfguard" --continue-on-collection-errors
 
@@ -120,6 +132,14 @@ stream-demo:
 # prints rows/s + bytes/row side by side (tools/wire_demo.py)
 wire-demo:
 	$(PYTHON) tools/wire_demo.py
+
+# drives the same scoring batch over tcp, uds, and the shm ring through
+# the real multi-worker pool (parity-gated) and prints per-transport
+# rows/s + bytes/row, the in-process ceiling, the end-to-end gap ratio,
+# and push-mode windows/s (tools/saturate_demo.py; bench.py's
+# `serving_saturation` leg runs the same tool)
+saturate-demo:
+	$(PYTHON) tools/saturate_demo.py
 
 # backtests the standard incident library through the real adaptive
 # loop at 100-1000x and prints the per-scenario verdict table +

@@ -885,6 +885,50 @@ def bench_replay(epochs=3, speed=500.0):
     }
 
 
+def bench_serving_saturation(rows=500, posts=40, workers=2, push_batches=8):
+    """Serving-plane saturation (ISSUE 13) — end-to-end rows/s per
+    transport (tcp / uds / shm ring) through the real multi-worker pool
+    with a bitwise parity gate, the end-to-end vs in-process gap ratio
+    (acceptance: within 5x), and push-mode windows-scored/s. Subprocess
+    (GORDO_STREAM/GORDO_PUSH knobs must land before server import) via
+    tools/saturate_demo.py."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "saturate_demo.py"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, tool, "--rows", str(rows), "--posts", str(posts),
+            "--workers", str(workers), "--push-batches", str(push_batches),
+        ],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"saturate demo failed: {' | '.join(tail[-3:])}")
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    assert doc["parity"] == "bitwise", doc
+    # the ISSUE 13 acceptance bar: best end-to-end transport within 5x
+    # of the in-process bank rate on this box
+    assert doc["end_to_end_gap_ratio"] <= 5.0, doc["end_to_end_gap_ratio"]
+    assert doc["push"]["windows_scored"] > 0, doc["push"]
+    return {
+        "saturation_rows_per_sec": {
+            name: leg["rows_per_sec"] for name, leg in doc["legs"].items()
+        },
+        "saturation_in_process_rows_per_sec": doc["in_process_rows_per_sec"],
+        "saturation_end_to_end_gap_ratio": doc["end_to_end_gap_ratio"],
+        "saturation_uds_vs_tcp": doc["uds_vs_tcp"],
+        "saturation_shm_vs_tcp": doc["shm_vs_tcp"],
+        "saturation_workers": doc["workers"],
+        "saturation_push_windows_per_sec": doc["push"]["windows_per_sec"],
+        "saturation_push_dropped": doc["push"]["dropped"],
+        "serving_saturation": doc,
+    }
+
+
 def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
     """Config 5 extension — sequence models served from the HBM bank
     (windowing runs in-graph with the bucket's static lookback)."""
@@ -1422,6 +1466,7 @@ METRICS = (
     ("rebalance", bench_rebalance),
     ("streaming", bench_streaming),
     ("replay", bench_replay),
+    ("serving_saturation", bench_serving_saturation),
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
@@ -1450,6 +1495,7 @@ CPU_KWARGS = {
     "rebalance": dict(members=64, request_rows=32),
     "streaming": dict(members=4, rows=64, epochs=2),
     "replay": dict(epochs=2),
+    "serving_saturation": dict(rows=300, posts=20, push_batches=5),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
     # the full 10k leg takes ~2.5 min on one core (measured; most of it

@@ -9,6 +9,7 @@ prediction store.
 """
 
 import asyncio
+import contextlib
 import functools
 import hashlib
 import itertools
@@ -106,6 +107,9 @@ class Client:
         metadata_fallback_dataset: Optional[Dict[str, Any]] = None,
         use_parquet="auto",
         use_tensor="auto",
+        transport: str = "auto",
+        uds_path: Optional[str] = None,
+        shm_ring: Optional[str] = None,
         retries: int = 3,
         backoff: float = 0.5,
         retry_budget: Optional[RetryBudget] = None,
@@ -178,6 +182,31 @@ class Client:
             )
         self.use_tensor = use_tensor
         self._tensor_active = False
+        # local zero-copy transport negotiation (server/workers.py +
+        # utils/shm_ring.py): "auto" climbs the ladder shm > uds > tcp
+        # using the server's /models ``transports`` advertisement, each
+        # rung verified LOCALLY (shm attachable, socket path present)
+        # before use — a remote server's advertisement never breaks a
+        # remote client, it just resolves to tcp. Explicit "uds"/"shm"
+        # try exactly that rung and degrade to tcp with a warning
+        # (graceful fallback); "tcp" is the classic path untouched.
+        if transport not in ("auto", "tcp", "uds", "shm"):
+            raise ValueError(
+                f"transport must be auto|tcp|uds|shm, got {transport!r}"
+            )
+        self.transport = transport
+        self.uds_path = uds_path
+        self.shm_ring = shm_ring
+        # resolved per run (predict_async): which rung actually carried
+        # the scoring chunks — bench/demo report this next to rows/s
+        self.transport_used = "tcp"
+        self._shm_client = None
+        self._data_session = None  # UDS session for scoring POSTs
+        # sessions retired mid-run by _drop_uds: closed at run end, not
+        # at retirement — sibling chunks may still have requests in
+        # flight on them, and an immediate close would turn their clean
+        # ClientConnectionError into an unhandled "Session is closed"
+        self._dead_sessions: List[Any] = []
         # per-encoding wire accounting (bench's bytes-per-row legs +
         # gordo_client_request_bytes_total): body bytes out and rows
         # posted for every scoring POST that got a 2xx back
@@ -284,6 +313,17 @@ class Client:
         urls = snapshot.get("replicas") or []
         return [u.rstrip("/") for u in urls if isinstance(u, str) and u]
 
+    def _connector_limit(self) -> int:
+        """Keep-alive pool size for the scoring session. Hedged chunks
+        open a SECOND in-flight socket while the primary is still
+        running (client/io.py:fetch_json_hedged) — sizing the pool to
+        ``parallelism`` alone made hedges queue behind the very sockets
+        they were meant to bypass, so the slowest ~5% of chunks paid the
+        hedge delay and then waited anyway. ``parallelism * (1 + hedge)``
+        lanes plus a little control-plane headroom."""
+        lanes = self.parallelism * (2 if self.hedge else 1)
+        return max(lanes + 4, 8)
+
     def _hedge_delay_s(self) -> float:
         """Hedge after the observed p95 (only the slowest ~5% of chunks
         duplicate work); until enough samples exist, the configured
@@ -297,6 +337,13 @@ class Client:
     def _chunk_urls(self, target: str, endpoint: str) -> List[str]:
         """Primary URL plus (hedging only) ONE alternate replica's URL
         for the same path."""
+        if self._data_session is not None:
+            # UDS session: the path is the address (the connector owns
+            # the socket); hedging is TCP-replica machinery and a local
+            # socket has no replicas — one URL, no hedge
+            return [
+                f"http://localhost/gordo/v0/{self.project}/{target}/{endpoint}"
+            ]
         urls = [self._url(target, endpoint)]
         if self.hedge:
             others = [u for u in self.replica_urls if u != self.base_url]
@@ -321,6 +368,158 @@ class Client:
             "X-Gordo-Request-Id": rid,
             "traceparent": format_traceparent(trace_id, trace_id[:16]),
         }
+
+    # ------------------------------------------------------------------ #
+    # local zero-copy transports (docs/architecture.md "Serving
+    # saturation"): negotiation + the shm scoring path
+    # ------------------------------------------------------------------ #
+
+    async def _resolve_transport(self, models_body) -> None:
+        """Pick the scoring transport for this run. The ladder (shm >
+        uds > tcp) combines local hints (``shm_ring=``/``uds_path=``)
+        with the server's ``/models`` ``transports`` advertisement, and
+        each rung must prove itself locally — attachable segment,
+        present + connectable socket path — before it carries chunks.
+        Every failure degrades one rung and logs why; tcp always
+        works."""
+        import os
+
+        self.transport_used = "tcp"
+        self._shm_client = None
+        self._data_session = None
+        if self.transport == "tcp":
+            return
+        adv = (models_body or {}).get("transports") or {}
+        if self.transport in ("auto", "shm"):
+            name = self.shm_ring or adv.get("shm")
+            if name:
+                try:
+                    from gordo_components_tpu.utils.shm_ring import (
+                        ShmRingClient,
+                    )
+
+                    self._shm_client = ShmRingClient(name)
+                    self.transport_used = "shm"
+                    logger.info("scoring over shm ring %r", name)
+                    return
+                except Exception as exc:
+                    logger.warning(
+                        "shm ring %r not attachable (%s); trying the next "
+                        "transport", name, exc,
+                    )
+            elif self.transport == "shm":
+                logger.warning(
+                    "transport='shm' but no ring name (pass shm_ring= or "
+                    "serve with GORDO_SHM_RING); falling back to tcp"
+                )
+        if self.transport in ("auto", "uds"):
+            path = self.uds_path or adv.get("uds")
+            if path and os.path.exists(path):
+                try:
+                    self._data_session = aiohttp.ClientSession(
+                        timeout=aiohttp.ClientTimeout(total=600),
+                        connector=aiohttp.UnixConnector(
+                            path=path, limit=self._connector_limit()
+                        ),
+                    )
+                    self.transport_used = "uds"
+                    logger.info("scoring over unix socket %s", path)
+                    return
+                except Exception as exc:
+                    logger.warning(
+                        "unix socket %s not usable (%s); falling back to "
+                        "tcp", path, exc,
+                    )
+            elif self.transport == "uds":
+                logger.warning(
+                    "transport='uds' but socket path %r does not exist; "
+                    "falling back to tcp", path,
+                )
+
+    async def _drop_uds(self, exc) -> None:
+        """Retire a dead unix-socket session mid-run (idempotent under
+        concurrent chunks: first caller wins, the rest see tcp). The
+        session object is parked for end-of-run closing — see
+        ``_dead_sessions``."""
+        s, self._data_session = self._data_session, None
+        self.transport_used = "tcp"
+        if s is not None:
+            logger.warning(
+                "unix-socket transport failed mid-run (%s); remaining "
+                "chunks go over tcp", exc,
+            )
+            self._dead_sessions.append(s)
+
+    async def _post_shm(
+        self, target: str, endpoint: str, chunk: pd.DataFrame,
+        chunk_y: Optional[pd.DataFrame],
+        deadline: Optional[Deadline] = None,
+    ) -> pd.DataFrame:
+        """One chunk over the shared-memory ring: same tensor body, same
+        response bytes, no socket. The ring wait runs on an executor
+        thread — the event loop keeps pumping the other chunks.
+
+        Same transient-failure citizenship as the HTTP path
+        (client/io.py): 408/429/5xx retry on decorrelated jitter
+        (honoring a 429 body's ``retry_after_s`` drain estimate as a
+        lower bound) through the shared retry budget; other non-200s
+        raise ``ValueError`` with the server's error document. The
+        chunk's ``deadline`` bounds the whole exchange CLIENT-side (ring
+        wait capped at the remaining budget, no retry sleep past
+        expiry, ``DeadlineExceeded`` once spent) — the slot envelope
+        carries no deadline field, so server-side expiry dropping is
+        the one HTTP nicety the shm rung does not replicate."""
+        from gordo_components_tpu.resilience.retry_budget import (
+            decorrelated_jitter,
+        )
+
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, self._encode_tensor, chunk, chunk_y
+        )
+        kind = "anomaly" if endpoint.startswith("anomaly") else "prediction"
+        self.retry_budget.note_request()
+        retries = max(1, self.retries)
+        prev_delay = self.backoff
+        for attempt in range(retries):
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    f"deadline expired before shm attempt {attempt + 1}"
+                )
+            ring_timeout = 60.0
+            if deadline is not None:
+                ring_timeout = max(1e-3, min(ring_timeout, deadline.remaining_s()))
+            status, resp = await asyncio.get_running_loop().run_in_executor(
+                None,
+                functools.partial(
+                    self._shm_client.request, target, body, kind,
+                    timeout=ring_timeout,
+                ),
+            )
+            if status < 400:
+                self._note_wire("tensor", len(body), len(chunk))
+                return self._decode_tensor_scoring_body(
+                    resp, chunk, anomaly=kind == "anomaly"
+                )
+            if status not in (408, 429) and status < 500:
+                break  # genuine request error: retrying cannot help
+            if attempt + 1 >= retries or not self.retry_budget.try_spend():
+                break
+            delay = prev_delay = decorrelated_jitter(
+                self.backoff, prev_delay
+            )
+            if status == 429:
+                try:  # the shed response's queue-drain estimate
+                    hinted = float(json.loads(resp).get("retry_after_s", 0))
+                    delay = max(delay, min(hinted, 60.0))
+                except (ValueError, AttributeError):
+                    pass
+            if deadline is not None:
+                # never sleep past our own expiry (same rule as io.py)
+                delay = min(delay, deadline.remaining_s())
+            await asyncio.sleep(delay)
+        raise ValueError(
+            f"shm status {status}: {resp[:500].decode('utf-8', 'replace')}"
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -399,10 +598,11 @@ class Client:
         sem = asyncio.Semaphore(self.parallelism)
         # keep-alive connections bounded a little above the chunk
         # concurrency: every chunk POST reuses a warm socket instead of
-        # paying handshake latency per request (the default limit is
-        # fine, but pinning it to the parallelism keeps a large
-        # parallelism= from opening sockets the semaphore never fills)
-        connector = aiohttp.TCPConnector(limit=max(self.parallelism + 4, 8))
+        # paying handshake latency per request. Sized for HEDGES too
+        # (_connector_limit): a hedged chunk holds two sockets at once,
+        # and a pool pinned to bare parallelism made hedges queue behind
+        # the primaries they were escaping.
+        connector = aiohttp.TCPConnector(limit=self._connector_limit())
         async with aiohttp.ClientSession(
             timeout=timeout, connector=connector
         ) as session:
@@ -411,6 +611,7 @@ class Client:
                 targets is None
                 or self.use_parquet == "auto"
                 or self.use_tensor == "auto"
+                or self.transport in ("auto", "uds", "shm")
             ):
                 try:
                     models_body = await fetch_json(
@@ -458,12 +659,25 @@ class Client:
                         "use_parquet=True but no parquet engine "
                         "(pyarrow/fastparquet) is installed"
                     )
-            results = await asyncio.gather(
-                *(
-                    self._predict_single(session, sem, t, start, end)
-                    for t in targets
+            await self._resolve_transport(models_body)
+            try:
+                results = await asyncio.gather(
+                    *(
+                        self._predict_single(session, sem, t, start, end)
+                        for t in targets
+                    )
                 )
-            )
+            finally:
+                if self._data_session is not None:
+                    await self._data_session.close()
+                    self._data_session = None
+                for dead in self._dead_sessions:
+                    with contextlib.suppress(Exception):
+                        await dead.close()
+                self._dead_sessions = []
+                if self._shm_client is not None:
+                    self._shm_client.close()
+                    self._shm_client = None
         if self.forwarder is not None:
             for result in results:
                 if result.ok:
@@ -662,16 +876,85 @@ class Client:
                 )
                 t0 = asyncio.get_running_loop().time()
                 tensor_exc = parquet_exc = None
-                if self._tensor_active:
+                # captured ONCE per chunk: when the unix socket dies
+                # mid-run, every in-flight sibling fails with the same
+                # ClientConnectionError, and each must know it was on
+                # the (now-retired) uds session — reading
+                # self._data_session after the first sibling nulled it
+                # would make the rest give up instead of retrying tcp
+                data_sess = self._data_session
+                if self._shm_client is not None and self._tensor_active:
+                    # the shared-memory rung: same tensor body, same
+                    # response bytes, zero sockets. A ring-level failure
+                    # degrades the RUN to the HTTP rungs below; a 4xx is
+                    # a genuine request error (the ring only ever faces
+                    # a gordo server, so there is no foreign-server
+                    # ambiguity to disambiguate).
                     try:
-                        frame = await self._post_tensor(
-                            session, target, endpoint, chunk, chunk_y,
-                            request_id=rid, deadline=deadline,
+                        frame = await self._post_shm(
+                            target, endpoint, chunk, chunk_y,
+                            deadline=deadline,
                         )
                         self._latency.record(
                             asyncio.get_running_loop().time() - t0
                         )
                         return frame
+                    except DeadlineExceeded as exc:
+                        errors.append(
+                            f"chunk {chunk.index[0]} (rid={rid}): deadline: {exc}"
+                        )
+                        return None
+                    except ValueError as exc:
+                        errors.append(f"chunk {chunk.index[0]} (rid={rid}): {exc}")
+                        return None
+                    except Exception as exc:
+                        logger.warning(
+                            "shm transport failed (%s); falling back to "
+                            "HTTP for the rest of the run", exc,
+                        )
+                        shm, self._shm_client = self._shm_client, None
+                        self.transport_used = (
+                            "uds" if self._data_session is not None else "tcp"
+                        )
+                        with contextlib.suppress(Exception):
+                            shm.close()
+                if self._tensor_active:
+                    try:
+                        frame = await self._post_tensor(
+                            data_sess or session, target, endpoint,
+                            chunk, chunk_y, request_id=rid, deadline=deadline,
+                        )
+                        self._latency.record(
+                            asyncio.get_running_loop().time() - t0
+                        )
+                        return frame
+                    except aiohttp.ClientConnectionError as exc:
+                        if data_sess is None:
+                            errors.append(
+                                f"chunk {chunk.index[0]} (rid={rid}): {exc}"
+                            )
+                            return None
+                        # mid-run unix-socket death (server restarted
+                        # without its UDS listener, path unlinked):
+                        # degrade the run to tcp and retry THIS chunk —
+                        # a transport failure must not masquerade as an
+                        # encoding rejection and cost the run its
+                        # tensor upgrade
+                        await self._drop_uds(exc)
+                        try:
+                            frame = await self._post_tensor(
+                                session, target, endpoint, chunk, chunk_y,
+                                request_id=rid, deadline=deadline,
+                            )
+                            self._latency.record(
+                                asyncio.get_running_loop().time() - t0
+                            )
+                            return frame
+                        except Exception as exc2:
+                            errors.append(
+                                f"chunk {chunk.index[0]} (rid={rid}): {exc2}"
+                            )
+                            return None
                     except ValueError as exc:
                         # 4xx on the tensor body: foreign server (or a
                         # genuine model error that any encoding would
@@ -690,8 +973,8 @@ class Client:
                 if self._parquet_active:
                     try:
                         body = await self._post_parquet(
-                            session, target, endpoint, chunk, chunk_y,
-                            request_id=rid, deadline=deadline,
+                            data_sess or session, target, endpoint,
+                            chunk, chunk_y, request_id=rid, deadline=deadline,
                         )
                         self._latency.record(
                             asyncio.get_running_loop().time() - t0
@@ -705,6 +988,17 @@ class Client:
                             )
                             self._tensor_active = False
                         return body
+                    except aiohttp.ClientConnectionError as exc:
+                        if data_sess is None:
+                            errors.append(
+                                f"chunk {chunk.index[0]} (rid={rid}): {exc}"
+                            )
+                            return None
+                        # unix socket died mid-run: degrade to tcp and
+                        # fall through to the JSON rung below — a
+                        # transport failure is not an encoding verdict
+                        await self._drop_uds(exc)
+                        data_sess = None
                     except ValueError as exc:
                         # 4xx on the parquet body. Ambiguous: the server
                         # may reject the ENCODING (foreign pod, no parse
@@ -734,9 +1028,10 @@ class Client:
                     functools.partial(json.dumps, payload, ensure_ascii=False),
                 )
                 json_body = json_body.encode("utf-8")
-                try:
-                    body = await fetch_json_hedged(
-                        session,
+
+                async def _post_json(sess):
+                    return await fetch_json_hedged(
+                        sess,
                         self._chunk_urls(target, endpoint),
                         hedge_delay_s=self._hedge_delay_s(),
                         hedge_stats=self._hedge_stats,
@@ -751,8 +1046,31 @@ class Client:
                         retry_budget=self.retry_budget,
                         deadline=deadline,
                     )
+
+                try:
+                    body = await _post_json(data_sess or session)
                     self._latency.record(asyncio.get_running_loop().time() - t0)
                     self._note_wire("json", len(json_body), len(chunk))
+                except aiohttp.ClientConnectionError as exc:
+                    if data_sess is None:
+                        errors.append(
+                            f"chunk {chunk.index[0]} (rid={rid}): {exc}"
+                        )
+                        return None
+                    # same mid-run unix-socket death handling as the
+                    # tensor rung: degrade to tcp and retry this chunk
+                    await self._drop_uds(exc)
+                    try:
+                        body = await _post_json(session)
+                        self._latency.record(
+                            asyncio.get_running_loop().time() - t0
+                        )
+                        self._note_wire("json", len(json_body), len(chunk))
+                    except Exception as exc2:
+                        errors.append(
+                            f"chunk {chunk.index[0]} (rid={rid}): {exc2}"
+                        )
+                        return None
                 except DeadlineExceeded as exc:
                     errors.append(
                         f"chunk {chunk.index[0]} (rid={rid}): deadline: {exc}"

@@ -194,6 +194,12 @@ def swap_bank(
         if engine is not None:
             # in-flight batches hold the old bank object and drain on it
             engine.bank = new_bank
+            # multi-worker pool (server/workers.py): the per-worker-loop
+            # engines front the same bank and must flip with it — a
+            # worker still pointing at the old generation would split
+            # the fleet's serving truth
+            for _wid, weng in app.get("worker_engines") or ():
+                weng.bank = new_bank
         elif len(new_bank) and _loop_running():
             # first generation with bankable members: the engine starts
             # here (the same path build_app's startup hook uses). Only
@@ -217,6 +223,8 @@ def swap_bank(
                 app.pop("bank_engine", None)
             elif old_engine_bank is not None:
                 engine.bank = old_engine_bank
+                for _wid, weng in app.get("worker_engines") or ():
+                    weng.bank = old_engine_bank
         app["bank_generation"] = old_generation
         _restore_collectors(app.get("metrics"), prev_collectors)
         logger.error(

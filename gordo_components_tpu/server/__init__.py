@@ -8,6 +8,7 @@ surface, so Ambassador-style routing by ``{target}`` still works.
 """
 
 import asyncio
+import contextlib
 import itertools
 import logging
 import os
@@ -38,6 +39,17 @@ logger = logging.getLogger(__name__)
 # server-generated request-id sequence (used when the client sent none);
 # process-wide so ids stay unique across app rebuilds in one process
 _RID_SEQ = itertools.count(1)
+
+# request-body size cap, shared with the worker pool (server/workers.py)
+# so every accept path — primary, workers, UDS — enforces ONE limit
+CLIENT_MAX_SIZE = 256 * 1024**2
+
+# stats mutation guard for multi-worker serving: with workers=1 (the
+# default) every mutation happens on one loop thread and stats["lock"]
+# is absent — this shared nullcontext keeps that path allocation-free
+# and lock-free. The worker pool (server/workers.py) installs a real
+# threading.Lock so N worker loops can't lose counter increments.
+_NO_LOCK = contextlib.nullcontext()
 
 
 def _trace_headers(headers, rid: str, trace) -> None:
@@ -79,26 +91,38 @@ async def _stats_middleware(request, handler):
         kind = "anomaly"
     else:
         kind = canonical.rsplit("/", 1)[-1] or "/"
-    stats["requests"][kind] = stats["requests"].get(kind, 0) + 1
-    if request.method == "POST" and kind in ("prediction", "anomaly", "ingest"):
-        # per-encoding data-plane accounting (stability contract:
-        # gordo_server_requests_total{encoding} + request_bytes_total):
-        # which wire format the fleet's clients actually negotiate, and
-        # the bytes each moves — the numbers the tensor-vs-JSON bench
-        # legs and the bytes-per-row dashboards read. ONE classification
-        # rule shared with the scoring handlers (utils/wire.py), so the
-        # metrics can never disagree with the path a request took.
-        from gordo_components_tpu.utils.wire import encoding_of
+    # multi-worker serving: stats["lock"] exists only when the worker
+    # pool installed it (workers > 1) — the default path stays the
+    # lock-free single-loop mutation it always was
+    lock = stats.get("lock") or _NO_LOCK
+    # which worker loop parsed this request (server/workers.py tags each
+    # worker app); absent (None) outside pool mode — no per-worker
+    # series render, the stability contract's default-off rule
+    worker = getattr(request.app, "gordo_worker", None)
+    with lock:
+        stats["requests"][kind] = stats["requests"].get(kind, 0) + 1
+        if worker is not None:
+            w = stats["workers"]
+            w[worker] = w.get(worker, 0) + 1
+        if request.method == "POST" and kind in ("prediction", "anomaly", "ingest"):
+            # per-encoding data-plane accounting (stability contract:
+            # gordo_server_requests_total{encoding} + request_bytes_total):
+            # which wire format the fleet's clients actually negotiate, and
+            # the bytes each moves — the numbers the tensor-vs-JSON bench
+            # legs and the bytes-per-row dashboards read. ONE classification
+            # rule shared with the scoring handlers (utils/wire.py), so the
+            # metrics can never disagree with the path a request took.
+            from gordo_components_tpu.utils.wire import encoding_of
 
-        enc = encoding_of(request.content_type)
-        wire = stats["wire"]
-        wire["requests"][enc] = wire["requests"].get(enc, 0) + 1
-        wire["bytes"][enc] = (
-            wire["bytes"].get(enc, 0) + (request.content_length or 0)
-        )
-    hist = stats["latency"].get(kind)
-    if hist is None:
-        hist = stats["latency"][kind] = LatencyHistogram()
+            enc = encoding_of(request.content_type)
+            wire = stats["wire"]
+            wire["requests"][enc] = wire["requests"].get(enc, 0) + 1
+            wire["bytes"][enc] = (
+                wire["bytes"].get(enc, 0) + (request.content_length or 0)
+            )
+        hist = stats["latency"].get(kind)
+        if hist is None:
+            hist = stats["latency"][kind] = LatencyHistogram()
     # bounded: a hostile header must not become an unbounded log/label blob
     rid = request.headers.get("X-Gordo-Request-Id", "")[:128] or (
         f"srv-{next(_RID_SEQ):x}"
@@ -137,7 +161,8 @@ async def _stats_middleware(request, handler):
         status = exc.status
         _trace_headers(exc.headers, rid, trace)
         if exc.status >= 400:
-            stats["errors"] += 1
+            with lock:
+                stats["errors"] += 1
         raise
     except Exception:
         # a handler crash is a 500; the counter must see exactly the
@@ -145,7 +170,8 @@ async def _stats_middleware(request, handler):
         # here (instead of re-raising into aiohttp's default handler)
         # still carries the request-id echo, so the one request a client
         # most wants to trace is the one that stays traceable
-        stats["errors"] += 1
+        with lock:
+            stats["errors"] += 1
         counted = True
         logger.exception(
             "unhandled error serving %s %s (rid=%s)",
@@ -158,7 +184,8 @@ async def _stats_middleware(request, handler):
         # errored requests count too: a timeout-then-500 pattern is
         # exactly what a tail-latency histogram exists to surface
         elapsed = time.monotonic() - t0
-        hist.record(elapsed)
+        with lock:
+            hist.record(elapsed)
         # goodput classification (observability/goodput.py): every
         # SCORING request commits its wall time + attributed device time
         # to the ledger with its final outcome — 504s are expired work,
@@ -173,12 +200,16 @@ async def _stats_middleware(request, handler):
         ):
             ledger = request.app.get("goodput")
             if ledger is not None:
-                ledger.finish_request(
-                    status=status,
-                    elapsed_s=elapsed,
-                    device_s=request.get("device_s", 0.0),
-                    scores_finite=request.get("scores_finite", True),
-                )
+                # under the pool, finish_request callers multiply (one
+                # per worker loop) — the ledger's single-writer cell
+                # contract is restored by the same stats lock
+                with lock:
+                    ledger.finish_request(
+                        status=status,
+                        elapsed_s=elapsed,
+                        device_s=request.get("device_s", 0.0),
+                        scores_finite=request.get("scores_finite", True),
+                    )
         if trace is not None:
             trace.finish(error=status >= 400, status=status)
             # exemplar-style link on the latency histogram: the LAST trace
@@ -193,13 +224,14 @@ async def _stats_middleware(request, handler):
                 from gordo_components_tpu.observability.metrics import _fmt
 
                 # _fmt renders inf as "+Inf", matching the bucket labels
-                stats.setdefault("exemplars", {}).setdefault(kind, {})[
-                    _fmt(hist.bucket_le(elapsed))
-                ] = {
-                    "trace_id": trace.trace_id,
-                    "value_ms": round(elapsed * 1e3, 3),
-                    "at": round(time.time(), 3),
-                }
+                with lock:
+                    stats.setdefault("exemplars", {}).setdefault(kind, {})[
+                        _fmt(hist.bucket_le(elapsed))
+                    ] = {
+                        "trace_id": trace.trace_id,
+                        "value_ms": round(elapsed * 1e3, 3),
+                        "at": round(time.time(), 3),
+                    }
         logger.debug(
             "access rid=%s trace=%s %s %s %d %.1fms",
             rid, trace.trace_id if trace is not None else "-",
@@ -207,7 +239,8 @@ async def _stats_middleware(request, handler):
         )
     _trace_headers(resp.headers, rid, trace)
     if not counted and resp.status >= 400:
-        stats["errors"] += 1
+        with lock:
+            stats["errors"] += 1
     return resp
 
 
@@ -250,6 +283,30 @@ def _server_collector(app: web.Application):
                 "gordo_server_request_bytes_total", "counter",
                 "Scoring/ingest request body bytes by wire encoding",
                 {"encoding": enc}, n,
+            )
+        # multi-worker accept-path balance (stability contract): which
+        # worker loop parsed each request — a worker starving while the
+        # others saturate is the SO_REUSEPORT/acceptor skew this series
+        # exists to show. Absent (no samples) outside pool mode.
+        for worker, n in sorted(stats.get("workers", {}).items()):
+            yield (
+                "gordo_server_worker_requests_total", "counter",
+                "HTTP requests parsed per worker event loop",
+                {"worker": worker}, n,
+            )
+        # local zero-copy transport counters (utils/shm_ring.py installs
+        # the cell when GORDO_SHM_RING arms the ring; absent otherwise)
+        shm = stats.get("shm")
+        if shm is not None:
+            yield (
+                "gordo_shm_requests_total", "counter",
+                "Scoring requests served over the shared-memory ring",
+                {}, shm["requests"],
+            )
+            yield (
+                "gordo_shm_errors_total", "counter",
+                "Shared-memory ring requests answered with an error "
+                "status", {}, shm["errors"],
             )
         for kind, hist in stats["latency"].items():
             yield (
@@ -408,7 +465,7 @@ def build_app(
         if want > 1:
             mesh = fleet_mesh(want)
     app = web.Application(
-        client_max_size=256 * 1024**2, middlewares=[_stats_middleware]
+        client_max_size=CLIENT_MAX_SIZE, middlewares=[_stats_middleware]
     )
     # the wall-time seam: every component whose semantics are defined in
     # wall time (streaming lateness/staleness, SLO windows) reads THIS
@@ -425,6 +482,9 @@ def build_app(
         # per-encoding data-plane counters (json|parquet|tensor): scoring
         # /ingest POST counts + request body bytes, fed by the middleware
         "wire": {"requests": {}, "bytes": {}},
+        # per-worker request counters (server/workers.py tags each worker
+        # loop's app): empty — and emitting no series — outside pool mode
+        "workers": {},
     }
     # operator default request budget (ms): applied by the middleware to
     # every request that carries no X-Gordo-Deadline-Ms header; None
@@ -545,6 +605,9 @@ def build_app(
                     max_batch=bank_max_batch,
                     flush_ms=bank_flush_ms,
                     max_queue=bank_max_queue,
+                    # present only under the worker pool: serializes this
+                    # engine's bank dispatches with the per-worker engines
+                    dispatch_lock=app.get("bank_dispatch_lock"),
                 )
                 engine.start()
                 app["bank_engine"] = engine
@@ -640,14 +703,45 @@ def run_server(
     port: int = 5555,
     target_name: Optional[str] = None,
     devices: Optional[int] = None,
+    workers: Optional[int] = None,
+    uds_path: Optional[str] = None,
+    shm_ring: Optional[str] = None,
 ) -> None:
     """Blocking server entrypoint (reference: ``run_server`` /
-    ``Dockerfile-ModelServer`` CMD)."""
+    ``Dockerfile-ModelServer`` CMD).
+
+    Saturation knobs (docs/operations.md "Saturating the serving
+    plane"): ``workers`` / ``GORDO_SERVER_WORKERS`` runs N parse loops
+    behind one accept path (server/workers.py); ``uds_path`` /
+    ``GORDO_UDS`` adds a Unix-domain-socket listener speaking the same
+    HTTP surface; ``shm_ring`` / ``GORDO_SHM_RING`` arms the
+    shared-memory scoring ring for co-located producers
+    (utils/shm_ring.py). All default OFF: with none set, this is the
+    exact single-loop ``web.run_app`` serving it always was.
+    """
+    from gordo_components_tpu.server.workers import ServerPool, resolve_workers
+
+    workers = resolve_workers(workers)
+    if uds_path is None:
+        uds_path = os.environ.get("GORDO_UDS") or None
+    if shm_ring is None:
+        shm_ring = os.environ.get("GORDO_SHM_RING") or None
     app = build_app(model_dir, target_name=target_name, devices=devices)
     logger.info(
         "Serving %d model(s) on %s:%d", len(app["collection"].models), host, port
     )
-    web.run_app(app, host=host, port=port)
+    if workers == 1 and not uds_path and not shm_ring:
+        web.run_app(app, host=host, port=port)
+        return
+    pool = ServerPool(
+        app, host=host, port=port, workers=workers,
+        uds_path=uds_path, shm_ring=shm_ring,
+    )
+    pool.start()
+    try:
+        pool.wait()
+    finally:
+        pool.stop()
 
 
 __all__ = ["build_app", "run_server", "ModelCollection", "ModelBank", "BatchingEngine"]

@@ -35,6 +35,9 @@ import os
 import time
 import weakref
 from collections import deque
+from concurrent.futures import Future as ConcurrentFuture
+from concurrent.futures import InvalidStateError as ConcurrentInvalidState
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -1777,8 +1780,19 @@ class BatchingEngine:
         flush_ms: float = 2.0,
         max_queue: Optional[int] = None,
         registry=None,
+        dispatch_lock=None,
     ):
         self.bank = bank
+        # multi-worker serving (server/workers.py): each worker loop
+        # runs its OWN engine over the ONE shared bank, and this shared
+        # threading.Lock serializes their bank calls on the executor
+        # threads — the device was never going to run two batches at
+        # once anyway, and per-worker engines mean a request never pays
+        # a cross-loop hop (measured at multiple GIL-switch intervals
+        # per request) while XLA's GIL release lets the other workers
+        # parse/coalesce DURING a dispatch. None (the default) is the
+        # classic single-engine layout with zero added work.
+        self.dispatch_lock = dispatch_lock
         self.max_batch = int(max_batch)
         self.flush_s = float(flush_ms) / 1e3
         if max_queue is None:
@@ -1788,6 +1802,12 @@ class BatchingEngine:
         self.max_queue = int(max_queue)
         self._queue: "asyncio.Queue[_Pending]" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # the loop that owns the queue + consumer task, captured at
+        # start(): every engine-internal future/queue op must happen on
+        # THIS loop. Other loops (multi-worker serving, server/workers.py)
+        # and plain threads (the shm transport) enter through submit() /
+        # score_blocking(), which hop here thread-safely.
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         # group-isolation capability of the current bank (score_many's
         # ``return_exceptions``), probed once per bank object: proxies
         # and stubs with the minimal score_many(requests) signature keep
@@ -1842,6 +1862,36 @@ class BatchingEngine:
             self.queue_wait = LatencyHistogram()
             self.service = LatencyHistogram()
 
+    @staticmethod
+    def _resolve(fut, result=None, exc=None) -> None:
+        """Resolve a pending's future, tolerating a concurrent
+        cancellation. Cross-loop/thread submissions carry
+        ``concurrent.futures.Future``s whose ``cancel()`` runs on the
+        CALLER's thread — a ``done()`` pre-check on the engine loop is
+        a TOCTOU, and an unguarded ``set_result`` racing it would raise
+        ``InvalidStateError`` out of ``_run_loop`` and kill the engine
+        task (every later request would then hang). A cancelled caller
+        no longer wants the result; dropping it is the correct
+        outcome."""
+        try:
+            if fut.done():
+                return
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except (ConcurrentInvalidState, asyncio.InvalidStateError):
+            pass
+
+    def _bank_call(self, fn, *args, **kwargs):
+        """Run a bank entrypoint (executor thread), serialized by the
+        shared dispatch lock when several worker engines front one
+        bank."""
+        if self.dispatch_lock is None:
+            return fn(*args, **kwargs)
+        with self.dispatch_lock:
+            return fn(*args, **kwargs)
+
     def _collect_metrics(self):
         """Read-through exposition of the engine's counters/queue state."""
         s = self.stats
@@ -1878,7 +1928,8 @@ class BatchingEngine:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            self._loop = asyncio.get_running_loop()
+            self._task = self._loop.create_task(self._run())
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -1888,6 +1939,116 @@ class BatchingEngine:
             except asyncio.CancelledError:
                 pass
             self._task = None
+            self._loop = None
+
+    async def submit(
+        self,
+        name: str,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        request_id: Optional[str] = None,
+        trace=None,
+        deadline: Optional[Deadline] = None,
+    ) -> ScoreResult:
+        """:meth:`score` from WHICHEVER event loop is running.
+
+        The engine's queue belongs to the loop that called :meth:`start`
+        (the primary serving loop). A multi-worker server
+        (server/workers.py) parses requests on N other loops; their
+        scoring hops here with ONE ``call_soon_threadsafe`` enqueue of a
+        thread-safe ``concurrent.futures.Future``-backed pending — NOT a
+        scheduled coroutine per request, whose wake-up jitter was
+        measured to spread arrivals across flush windows and collapse
+        the coalesced batch size (the whole point of the engine).
+        Admission checks (expiry, shed) run caller-side against an
+        approximate queue depth; their counters bump on the engine loop.
+        Same-loop callers (workers=1, the default) take the direct
+        path: one loop identity check, nothing else.
+        """
+        # local capture: stop() nulls self._loop from another thread —
+        # the check and every use below must see ONE value
+        loop = self._loop
+        if loop is None or asyncio.get_running_loop() is loop:
+            return await self.score(
+                name, X, y, request_id=request_id, trace=trace,
+                deadline=deadline,
+            )
+        _FP_ENGINE_QUEUE.fire()
+        if deadline is not None and deadline.expired():
+            self._bump_threadsafe("deadline_expired")
+            raise DeadlineExceeded(
+                f"deadline expired before admission (rid={request_id}, "
+                f"budget {deadline.budget_s * 1e3:.0f}ms)"
+            )
+        depth = self._queue.qsize()  # racy read: shed is a heuristic gate
+        if depth >= self.max_queue:
+            self._bump_threadsafe("shed")
+            if self.service.count:
+                batch_s = max(
+                    self.service.percentile(0.5)
+                    - self.queue_wait.percentile(0.5),
+                    1e-3,
+                )
+            else:
+                batch_s = 0.05
+            raise EngineOverloaded(
+                depth, max(self.flush_s, depth / self.max_batch * batch_s)
+            )
+        fut: Any = ConcurrentFuture()  # thread-safe resolve from the engine loop
+        pending = _Pending(
+            name, X, y, fut, time.monotonic(), request_id, trace, deadline
+        )
+        loop.call_soon_threadsafe(self._queue.put_nowait, pending)
+        # wrap_future bridges resolution (and caller-side cancellation)
+        # back onto this worker's loop
+        return await asyncio.wrap_future(fut)
+
+    def _bump_threadsafe(self, key: str) -> None:
+        """Counter increment from a foreign loop/thread, serialized onto
+        the engine's loop so stats never lose increments."""
+        loop = self._loop
+        try:
+            if loop is not None:
+                loop.call_soon_threadsafe(
+                    lambda: self.stats.__setitem__(key, self.stats[key] + 1)
+                )
+        except RuntimeError:
+            pass  # engine loop already closed (shutdown race): drop the count
+
+    def score_blocking(
+        self,
+        name: str,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        request_id: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> ScoreResult:
+        """:meth:`score` from a plain thread (the shared-memory transport
+        server, utils/shm_ring.py): blocks the calling thread — never an
+        event loop — until the engine resolves the result. Same direct
+        thread-safe enqueue as cross-loop :meth:`submit`, so concurrent
+        shm slots coalesce into the same batches as HTTP traffic."""
+        loop = self._loop  # local: stop() nulls the attribute cross-thread
+        if loop is None or not loop.is_running():
+            raise RuntimeError(
+                "engine loop is not running (start() the engine on a live "
+                "event loop before submitting from threads)"
+            )
+        _FP_ENGINE_QUEUE.fire()
+        depth = self._queue.qsize()
+        if depth >= self.max_queue:
+            self._bump_threadsafe("shed")
+            raise EngineOverloaded(depth, self.flush_s)
+        fut: Any = ConcurrentFuture()
+        pending = _Pending(
+            name, X, y, fut, time.monotonic(), request_id, None, None
+        )
+        loop.call_soon_threadsafe(self._queue.put_nowait, pending)
+        try:
+            return fut.result(timeout)
+        except FuturesTimeoutError:
+            fut.cancel()
+            raise
 
     async def score(
         self,
@@ -1962,8 +2123,14 @@ class BatchingEngine:
                     p.future.cancel()
 
     async def _run_loop(self, loop, batch: List[_Pending]) -> None:
+        requests = results = live = failed = None
         while True:
             batch.clear()
+            # release the previous batch's references BEFORE blocking on
+            # the queue: an idle engine must not pin the last requests'
+            # arrays (for the shm transport those are np.frombuffer
+            # views over the mapped ring) until new traffic arrives
+            requests = results = live = failed = None  # noqa: F841
             first = await self._queue.get()
             batch.append(first)
             deadline = time.monotonic() + self.flush_s
@@ -2009,15 +2176,15 @@ class BatchingEngine:
                             "deadline_expired", p.enqueued, dispatch,
                             error=True, where="queue",
                         )
-                    if not p.future.done():
-                        p.future.set_exception(
-                            DeadlineExceeded(
-                                f"deadline expired in scoring queue after "
-                                f"{(dispatch - p.enqueued) * 1e3:.0f}ms wait "
-                                f"(rid={p.request_id}, budget "
-                                f"{p.deadline.budget_s * 1e3:.0f}ms)"
-                            )
-                        )
+                    self._resolve(
+                        p.future,
+                        exc=DeadlineExceeded(
+                            f"deadline expired in scoring queue after "
+                            f"{(dispatch - p.enqueued) * 1e3:.0f}ms wait "
+                            f"(rid={p.request_id}, budget "
+                            f"{p.deadline.budget_s * 1e3:.0f}ms)"
+                        ),
+                    )
                     self.service.record(dispatch - p.enqueued)
                 else:
                     live.append(p)
@@ -2060,6 +2227,7 @@ class BatchingEngine:
                     results = await loop.run_in_executor(
                         None,
                         functools.partial(
+                            self._bank_call,
                             self.bank.score_many,
                             requests,
                             traces=[p.trace for p in batch] if traced else None,
@@ -2074,6 +2242,7 @@ class BatchingEngine:
                     results = await loop.run_in_executor(
                         None,
                         functools.partial(
+                            self._bank_call,
                             self.bank.score_many,
                             requests,
                             traces=[p.trace for p in batch] if traced else None,
@@ -2082,12 +2251,12 @@ class BatchingEngine:
                     )
                 elif traced:
                     results = await loop.run_in_executor(
-                        None, self.bank.score_many, requests,
+                        None, self._bank_call, self.bank.score_many, requests,
                         [p.trace for p in batch],
                     )
                 else:
                     results = await loop.run_in_executor(
-                        None, self.bank.score_many, requests
+                        None, self._bank_call, self.bank.score_many, requests
                     )
             except Exception:
                 # one bad request must not poison the batch: retry each
@@ -2108,8 +2277,7 @@ class BatchingEngine:
                     # per-request recovery path
                     failed.append(p)
                     continue
-                if not p.future.done():
-                    p.future.set_result(r)
+                self._resolve(p.future, result=r)
                 self.service.record(done - p.enqueued)
             # healthy futures resolve BEFORE any retry work: a failed
             # group's sequential per-request rescores must not sit in
@@ -2154,14 +2322,14 @@ class BatchingEngine:
                     "deadline_expired", p.enqueued, now,
                     error=True, where="retry",
                 )
-            if not p.future.done():
-                p.future.set_exception(
-                    DeadlineExceeded(
-                        f"deadline expired before retry "
-                        f"(rid={p.request_id}, budget "
-                        f"{p.deadline.budget_s * 1e3:.0f}ms)"
-                    )
-                )
+            self._resolve(
+                p.future,
+                exc=DeadlineExceeded(
+                    f"deadline expired before retry "
+                    f"(rid={p.request_id}, budget "
+                    f"{p.deadline.budget_s * 1e3:.0f}ms)"
+                ),
+            )
             self.service.record(time.monotonic() - p.enqueued)
             return
         try:
@@ -2177,11 +2345,12 @@ class BatchingEngine:
                 retry_trace = None
             if retry_trace is not None:
                 r = await loop.run_in_executor(
-                    None, self.bank.score, p.name, p.X, p.y, retry_trace,
+                    None, self._bank_call, self.bank.score, p.name, p.X, p.y,
+                    retry_trace,
                 )
             else:
                 r = await loop.run_in_executor(
-                    None, self.bank.score, p.name, p.X, p.y
+                    None, self._bank_call, self.bank.score, p.name, p.X, p.y
                 )
         except Exception as exc:
             # rid ties this failure back to the access-log line (and
@@ -2190,9 +2359,7 @@ class BatchingEngine:
                 "engine request for %r failed (rid=%s): %s",
                 p.name, p.request_id, exc,
             )
-            if not p.future.done():
-                p.future.set_exception(exc)
+            self._resolve(p.future, exc=exc)
         else:
-            if not p.future.done():
-                p.future.set_result(r)
+            self._resolve(p.future, result=r)
         self.service.record(time.monotonic() - p.enqueued)

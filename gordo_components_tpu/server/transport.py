@@ -1,0 +1,250 @@
+"""Transport-agnostic scoring core + the shared-memory ring server.
+
+The HTTP views own the request/response *protocol* (headers, deadlines,
+traces); this module owns the part every transport shares — "GTNS bytes
+in, GTNS bytes out, through the same engine/bank the HTTP path uses" —
+so the shared-memory ring (utils/shm_ring.py) answers byte-identically
+to a TCP or UDS POST of the same body (the bitwise cross-transport
+parity contract in tests/test_wire.py).
+"""
+
+import json
+import logging
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gordo_components_tpu.server.model_io import (
+    anomaly_frame_arrays,
+    decode_tensor_request,
+    encode_anomaly_response,
+    encode_prediction_response,
+)
+from gordo_components_tpu.utils.shm_ring import (
+    BUSY,
+    DEFAULT_SLOT_MB,
+    DEFAULT_SLOTS,
+    REQ,
+    ShmRing,
+    ShmRingError,
+    unpack_envelope,
+    _IDLE_SLEEP_MAX,
+    _IDLE_SLEEP_MIN,
+)
+from gordo_components_tpu.utils.wire import WireFormatError
+
+logger = logging.getLogger(__name__)
+
+
+def _err(status: int, body: dict) -> Tuple[int, bytes]:
+    return status, json.dumps(body).encode("utf-8")
+
+
+def _note_result(app, target: str, X_arr, values) -> None:
+    """The quarantine breaker's verdict, transport-side: same rule as
+    views._note_scoring_result (finite output resets the streak;
+    non-finite output from finite input counts), minus the HTTP-only
+    goodput stash."""
+    quarantine = app.get("quarantine")
+    if quarantine is None:
+        return
+    arr = np.asarray(values)
+    finite = bool(np.all(np.isfinite(arr)))
+    if finite:
+        quarantine.record_success(target)
+    elif bool(np.all(np.isfinite(np.asarray(X_arr)))):
+        quarantine.record_failure(target, "non-finite scores in model output")
+
+
+def score_tensor_blocking(
+    app, target: str, raw, endpoint: str = "anomaly"
+) -> Tuple[int, bytes]:
+    """Score one ``GTNS`` request body exactly as the HTTP tensor path
+    would, from a plain thread. Returns ``(status, response_bytes)``:
+    200 bodies are the same ``encode_*_response`` bytes the views emit;
+    error statuses carry the same JSON error documents (404 unknown
+    target, 410 quarantine with reason, 400 malformed/model error, 429
+    overload) — so a producer can switch transports without changing
+    its error handling.
+
+    ``raw`` may be a memoryview straight over a mapped shm slot: the
+    decode is ``np.frombuffer`` views over it (zero-copy end to end
+    until the bank's own coalescing stage).
+    """
+    from gordo_components_tpu.resilience.deadline import DeadlineExceeded
+    from gordo_components_tpu.server.bank import EngineOverloaded
+
+    collection = app["collection"]
+    try:
+        model, _meta = collection.entry(target)
+    except KeyError:
+        return _err(404, {"error": f"No such model: {target}"})
+    quarantine = app.get("quarantine")
+    if quarantine is not None and target in quarantine:
+        info = quarantine.reason(target) or {}
+        return _err(
+            410,
+            {
+                "error": f"Model {target!r} is quarantined",
+                "reason": info.get("reason"),
+                "failures": info.get("failures"),
+                "since": info.get("since"),
+            },
+        )
+    if endpoint == "anomaly" and not hasattr(model, "anomaly"):
+        return _err(422, {"error": "Model does not support anomaly scoring"})
+    try:
+        Xf, yf = decode_tensor_request(raw)
+    except WireFormatError as exc:
+        return _err(400, {"error": f"tensor body: {exc}"})
+    engine = app.get("bank_engine")
+    banked = engine is not None and target in getattr(engine, "bank", ())
+    try:
+        if endpoint == "anomaly":
+            if banked:
+                result = engine.score_blocking(target, Xf, yf)
+                body = encode_anomaly_response(
+                    result.tags, result.to_arrays(), result.offset
+                )
+                total_scaled = result.total_scaled
+            else:
+                import pandas as pd
+
+                frame = model.anomaly(
+                    pd.DataFrame(Xf), None if yf is None else pd.DataFrame(yf)
+                )
+                body = encode_anomaly_response(
+                    frame["model-input"].columns,
+                    anomaly_frame_arrays(frame),
+                    len(Xf) - len(frame),
+                )
+                total_scaled = frame[("total-anomaly-scaled", "")].to_numpy()
+            _note_result(app, target, Xf, total_scaled)
+            return 200, body
+        if banked:
+            result = engine.score_blocking(target, Xf)
+            output = result.model_output
+        else:
+            output = model.predict(Xf)
+        _note_result(app, target, Xf, output)
+        return 200, encode_prediction_response(output, len(Xf))
+    except EngineOverloaded as exc:
+        return _err(
+            429, {"error": str(exc), "retry_after_s": round(exc.retry_after_s, 2)}
+        )
+    except DeadlineExceeded as exc:
+        return _err(504, {"error": str(exc)})
+    except Exception as exc:
+        # same contract as the views: model errors are 400s with detail,
+        # and only non-input-shaped failures count against the breaker
+        if quarantine is not None and not isinstance(
+            exc, (ValueError, KeyError)
+        ):
+            quarantine.record_failure(target, f"{type(exc).__name__}: {exc}")
+        logger.exception("shm scoring failed for %r", target)
+        return _err(400, {"error": f"{type(exc).__name__}: {exc}"})
+
+
+class ShmServer:
+    """The server end of the scoring ring: one poll thread that parses
+    ``REQ`` slots straight off the mapped segment and answers in place.
+
+    Scoring funnels through the SAME batching engine the HTTP handlers
+    use (``BatchingEngine.score_blocking`` hops onto the engine's loop),
+    so shm requests coalesce into the same device batches as TCP/UDS
+    traffic — the transports differ in copies, never in math. Counters
+    land in ``stats["shm"]`` (surfaced via ``/stats`` and
+    ``gordo_shm_requests_total``); this thread is their only writer.
+    """
+
+    def __init__(self, app, ring: ShmRing):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.app = app
+        self.ring = ring
+        self.stats = {"requests": 0, "errors": 0, "bytes_in": 0, "bytes_out": 0}
+        self._stats_lock = threading.Lock()
+        app["stats"]["shm"] = self.stats
+        self._stop = threading.Event()
+        # slots are served CONCURRENTLY (one pool worker per in-flight
+        # slot): N producers' requests reach the engine together and
+        # coalesce into the same device batches as HTTP traffic — a
+        # serial slot loop would cap the ring at one dispatch per round
+        # trip and waste the batching the engine exists for
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, min(ring.slots, 8)),
+            thread_name_prefix="gordo-shm-worker",
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="gordo-shm-server", daemon=True
+        )
+        self._thread.start()
+
+    @classmethod
+    def create(
+        cls,
+        app,
+        name: str,
+        slots: Optional[int] = None,
+        slot_mb: Optional[float] = None,
+    ) -> "ShmServer":
+        import os
+
+        if slots is None:
+            slots = int(os.environ.get("GORDO_SHM_SLOTS", DEFAULT_SLOTS))
+        if slot_mb is None:
+            slot_mb = float(os.environ.get("GORDO_SHM_SLOT_MB", DEFAULT_SLOT_MB))
+        ring = ShmRing.create(name, slots=slots, slot_mb=slot_mb)
+        transports = dict(app.get("transports") or {})
+        transports["shm"] = name
+        app["transports"] = transports
+        return cls(app, ring)
+
+    def _run(self) -> None:
+        sleep = _IDLE_SLEEP_MIN
+        while not self._stop.is_set():
+            dispatched = 0
+            for i in range(self.ring.slots):
+                if self.ring.closed or self._stop.is_set():
+                    return
+                if self.ring.state(i) != REQ:
+                    continue
+                self.ring.set_state(i, BUSY)
+                dispatched += 1
+                self._pool.submit(self._serve_slot, i)
+            if dispatched:
+                sleep = _IDLE_SLEEP_MIN
+            else:
+                time.sleep(sleep)
+                sleep = min(sleep * 2, _IDLE_SLEEP_MAX)
+
+    def _serve_slot(self, i: int) -> None:
+        n_in = 0
+        try:
+            payload = self.ring.request_view(i)
+            target, endpoint, body = unpack_envelope(payload)
+            n_in = len(body)
+            status, resp = score_tensor_blocking(self.app, target, body, endpoint)
+        except (ShmRingError, Exception) as exc:  # noqa: BLE001
+            status, resp = _err(400, {"error": f"{type(exc).__name__}: {exc}"})
+        with self._stats_lock:
+            self.stats["requests"] += 1
+            self.stats["bytes_in"] += n_in
+            if status >= 400:
+                self.stats["errors"] += 1
+            self.stats["bytes_out"] += len(resp)
+        try:
+            self.ring.write_response(i, status, resp)
+        except Exception:
+            logger.exception("failed to answer shm slot %d", i)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(10.0)
+        self._pool.shutdown(wait=True)
+        self.ring.close()
+
+
+__all__ = ["ShmServer", "score_tensor_blocking"]

@@ -7,25 +7,68 @@ multi-level-column DataFrame ⇄ nested-dict JSON contract used by
 """
 
 import io
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 import pandas as pd
 
 
-def get_reload_lock(app):
-    """The app's bank-rebuild serialization lock, created lazily on the
-    event loop (aiohttp handlers share one loop thread and there is no
-    await between check and set, so the init is race-free). Every path
-    that rebuilds the bank — ``/reload``, the placement controller, the
-    streaming adaptation plane — MUST serialize under this one lock:
-    two concurrent rebuilds would race the generation flip and double
-    device memory twice over."""
-    import asyncio
+class CrossLoopLock:
+    """An ``async with``-able mutex that works across EVENT LOOPS.
 
+    ``asyncio.Lock`` binds to one loop; under the multi-worker server
+    (server/workers.py) ``/reload``/``/rebalance``/``/adapt`` handlers
+    can run on any worker's loop, and a loop-bound lock would either
+    error or — worse — not actually exclude. This wraps a
+    ``threading.Lock``: the uncontended acquire is one non-blocking
+    try (the workers=1 fast path costs what ``asyncio.Lock`` did); a
+    contended acquire polls with a short async sleep, which keeps the
+    waiting LOOP serving traffic AND stays cancellation-safe — a
+    cancelled waiter never holds the lock (an executor-thread acquire
+    here would be uncancellable and could acquire after its waiter was
+    gone, wedging every future rebuild). Contention is rare (reload/
+    rebalance/adapt, each seconds long), so the poll adds at most one
+    sleep interval to an already-slow path."""
+
+    _POLL_S = 0.02
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def __aenter__(self):
+        import asyncio
+
+        while not self._lock.acquire(blocking=False):
+            await asyncio.sleep(self._POLL_S)
+        return self
+
+    async def __aexit__(self, *exc):
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+
+# guards lazy creation: with multiple worker loops the old "no await
+# between check and set" single-loop argument no longer holds
+_RELOAD_LOCK_INIT = threading.Lock()
+
+
+def get_reload_lock(app):
+    """The app's bank-rebuild serialization lock, created lazily. Every
+    path that rebuilds the bank — ``/reload``, the placement controller,
+    the streaming adaptation plane — MUST serialize under this one lock:
+    two concurrent rebuilds would race the generation flip and double
+    device memory twice over. Cross-loop by construction (see
+    :class:`CrossLoopLock`) so the guarantee survives multi-worker
+    serving, where the competing handlers live on different loops."""
     lock = app.get("reload_lock")
     if lock is None:
-        lock = app["reload_lock"] = asyncio.Lock()
+        with _RELOAD_LOCK_INIT:
+            lock = app.get("reload_lock")
+            if lock is None:
+                lock = app["reload_lock"] = CrossLoopLock()
     return lock
 
 

@@ -78,11 +78,26 @@ def _get_model(request: web.Request):
 
 
 def _bank_engine(request: web.Request):
-    """The continuous-batching engine, if the target is bank-resident."""
-    engine = request.app.get("bank_engine")
+    """The continuous-batching engine, if the target is bank-resident.
+
+    Under the worker pool each parse loop owns a LOCAL engine over the
+    shared bank (server/workers.py) — scoring must use it, never the
+    primary's: a cross-loop hop per request costs GIL-switch stalls and
+    breaks the local loop's batch coalescing."""
+    engine = getattr(request.app, "gordo_engine", None) or request.app.get(
+        "bank_engine"
+    )
     if engine is not None and request.match_info["target"] in engine.bank:
         return engine
     return None
+
+
+def _engine_score(engine):
+    """The engine's any-loop scoring entry: ``submit`` hops to the
+    engine's own loop when the handler runs on a multi-worker parse loop
+    (server/workers.py) and is a pure pass-through on the primary loop.
+    Test stubs that only implement ``score`` keep working."""
+    return getattr(engine, "submit", None) or engine.score
 
 
 def _quarantine_gate(request: web.Request) -> None:
@@ -260,6 +275,12 @@ async def list_models(request: web.Request) -> web.Response:
         "accepts": ["application/json", TENSOR_CONTENT_TYPE]
         + (["application/x-parquet"] if _PARQUET_OK else []),
     }
+    # local zero-copy transports (server/workers.py + utils/shm_ring.py):
+    # the negotiation ladder a co-located client's transport="auto"
+    # climbs — shm > uds > tcp, each rung verified locally before use
+    transports = request.app.get("transports")
+    if transports:
+        body["transports"] = dict(transports)
     bank = _bank_coverage(request, body["models"])
     if bank is not None:
         body["bank"] = bank
@@ -496,7 +517,17 @@ async def server_stats(request: web.Request) -> web.Response:
             "requests": dict(stats.get("wire", {}).get("requests", {})),
             "bytes": dict(stats.get("wire", {}).get("bytes", {})),
         },
+        # multi-worker accept balance (server/workers.py): requests
+        # parsed per worker loop — empty outside pool mode
+        "workers": dict(stats.get("workers", {})),
     }
+    shm = stats.get("shm")
+    if shm is not None:
+        # the shared-memory ring's data plane (utils/shm_ring.py)
+        body["shm"] = dict(shm)
+    transports = request.app.get("transports")
+    if transports:
+        body["transports"] = dict(transports)
     engine = request.app.get("bank_engine")
     if engine is not None:
         es = dict(engine.stats)
@@ -511,6 +542,17 @@ async def server_stats(request: web.Request) -> web.Response:
         es["max_queue"] = engine.max_queue
         es["queue_depth"] = engine._queue.qsize()
         body["bank_engine"] = es
+    worker_engines = request.app.get("worker_engines")
+    if worker_engines:
+        # the per-worker-loop engines of the multi-worker pool: their
+        # coalescing/shed state, next to the primary engine's above
+        body["worker_engines"] = {
+            wid: {
+                **dict(weng.stats),
+                "queue_depth": weng._queue.qsize(),
+            }
+            for wid, weng in worker_engines
+        }
     bank = request.app.get("bank")
     if bank is not None:
         body["bank_models"] = len(bank)
@@ -867,6 +909,68 @@ async def ingest_rows(request: web.Request) -> web.Response:
     return web.json_response({"target": target, **counts})
 
 
+@routes.get("/gordo/v0/{project}/{target}/results/stream")
+async def results_stream(request: web.Request) -> web.Response:
+    """Push-mode long poll (streaming/push.py): scored-window results
+    for the target since the subscriber's last poll, waiting up to
+    ``?timeout=`` (default 10s, max 60) for the first one. Pass a stable
+    ``?subscriber=`` id to keep one bounded queue across polls (absent:
+    a fresh id is minted and echoed — results published BEFORE the
+    first poll with it are not replayed). The response's ``dropped``
+    counts results this subscriber lost to its bounded queue
+    (drop-oldest — the backpressure rule); 429 past
+    ``GORDO_PUSH_SUBSCRIBERS_MAX`` subscribers."""
+    plane = _stream_plane(request)
+    broker = getattr(plane, "broker", None)
+    if broker is None:
+        raise web.HTTPNotFound(
+            text=json.dumps(
+                {"error": "push mode not enabled (GORDO_PUSH=0)"}
+            ),
+            content_type="application/json",
+        )
+    _get_model(request)  # unknown targets 404, same as scoring
+    target = request.match_info["target"]
+    subscriber = request.query.get("subscriber", "")[:128]
+    if not subscriber:
+        import uuid
+
+        subscriber = uuid.uuid4().hex[:12]
+    try:
+        timeout = float(request.query.get("timeout", "10"))
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "timeout must be a number"}),
+            content_type="application/json",
+        )
+    timeout = min(max(timeout, 0.0), 60.0)
+    if not broker.subscribe(subscriber, target):
+        raise web.HTTPTooManyRequests(
+            text=json.dumps(
+                {
+                    "error": "push subscriber table full "
+                    "(GORDO_PUSH_SUBSCRIBERS_MAX)",
+                }
+            ),
+            content_type="application/json",
+        )
+    # the wait parks on the push plane's DEDICATED poll pool (sized to
+    # the subscriber bound), never the event loop and never the default
+    # executor the batching engine dispatches through — parked polls
+    # must not starve the scoring that would wake them
+    results, dropped = await asyncio.get_running_loop().run_in_executor(
+        plane.poll_executor, broker.poll, subscriber, target, timeout
+    )
+    return web.json_response(
+        {
+            "subscriber": subscriber,
+            "target": target,
+            "results": results,
+            "dropped": dropped,
+        }
+    )
+
+
 @routes.post("/gordo/v0/{project}/adapt")
 async def adapt(request: web.Request) -> web.Response:
     """Apply the online adaptation: recalibrate (default) or
@@ -1023,7 +1127,7 @@ async def prediction(request: web.Request) -> web.Response:
     deadline = request.get("deadline")
     try:
         if engine is not None:
-            result = await engine.score(
+            result = await _engine_score(engine)(
                 target,
                 Xf,
                 request_id=request.get("request_id"),
@@ -1099,7 +1203,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     frame = None
     try:
         if engine is not None:
-            result = await engine.score(
+            result = await _engine_score(engine)(
                 target,
                 Xf,
                 yf,

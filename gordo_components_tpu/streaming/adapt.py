@@ -32,6 +32,7 @@ import copy
 import functools
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -88,6 +89,39 @@ class StreamingPlane:
             os.environ.get("GORDO_STREAM_ADAPT", "").strip().lower() == "auto"
         )
         self._task: Optional[asyncio.Task] = None
+        # score-on-ingest push mode (streaming/push.py; DEFAULT OFF):
+        # windows score as their watermark advances and results fan out
+        # to long-poll subscribers instead of being re-paid per poll
+        self.push_enabled = (
+            os.environ.get("GORDO_PUSH", "0") not in ("0", "", "false")
+        )
+        self.broker = None
+        self._push_task: Optional[asyncio.Task] = None
+        self._push_dirty: set = set()
+        self._push_dirty_lock = threading.Lock()
+        self._pushed_wm: Dict[str, float] = {}
+        self.push_stats: Dict[str, int] = {"windows_scored": 0, "publish_failed": 0}
+        self.poll_executor = None
+        if self.push_enabled:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from gordo_components_tpu.streaming.push import PushBroker
+
+            self.push_interval_s = _env_num("GORDO_PUSH_INTERVAL_S", 0.25, float)
+            self.broker = PushBroker(
+                queue_max=_env_num("GORDO_PUSH_QUEUE", 64, int),
+                max_subscribers=_env_num("GORDO_PUSH_SUBSCRIBERS_MAX", 16, int),
+                sub_ttl_s=_env_num("GORDO_PUSH_SUB_TTL_S", 120.0, float),
+                clock=self.clock,
+            )
+            # long-polls park a thread for up to their timeout; a
+            # DEDICATED pool (sized to the subscriber bound) keeps them
+            # from starving the loop's default executor, which the
+            # batching engine needs for every bank dispatch
+            self.poll_executor = ThreadPoolExecutor(
+                max_workers=self.broker.max_subscribers,
+                thread_name_prefix="gordo-push-poll",
+            )
         self.stats: Dict[str, Any] = {
             "adaptations": 0,
             "recalibrated_members": 0,
@@ -178,11 +212,41 @@ class StreamingPlane:
             "Refit/recalibration attempts that failed and rolled back",
             {}, self.stats["refit_failed"],
         )
+        if self.broker is not None:
+            # push-mode surface (stability contract): absent entirely at
+            # the GORDO_PUSH=0 default, like the rest of the plane
+            bs = self.broker.stats()
+            yield (
+                "gordo_push_windows_scored_total", "counter",
+                "Windows scored by the push loop as watermarks advanced",
+                {}, self.push_stats["windows_scored"],
+            )
+            yield (
+                "gordo_push_published_total", "counter",
+                "Scored-window results delivered to at least one "
+                "subscriber", {}, bs["published_total"],
+            )
+            yield (
+                "gordo_push_dropped_total", "counter",
+                "Results dropped from slow subscribers' bounded queues "
+                "(drop-oldest)", {}, bs["dropped_total"],
+            )
+            yield (
+                "gordo_push_subscribers", "gauge",
+                "Live push subscribers", {}, bs["subscribers"],
+            )
 
     # ---------------------------- ingestion ---------------------------- #
 
     def ingest(self, name: str, event_ts, values) -> Dict[str, Any]:
-        return self.ingestor.ingest(name, event_ts, values)
+        counts = self.ingestor.ingest(name, event_ts, values)
+        if self.broker is not None and counts.get("accepted"):
+            # one set-add per accepted batch (thread-safe: ingest may
+            # run on any worker loop); the push loop scores the member's
+            # advanced window off the request path
+            with self._push_dirty_lock:
+                self._push_dirty.add(name)
+        return counts
 
     # ------------------------- drift evaluation ------------------------ #
 
@@ -197,7 +261,99 @@ class StreamingPlane:
         body["interval_s"] = self.interval_s
         body["refit_threshold"] = self.refit_threshold
         body["stats"] = dict(self.stats)
+        push: Dict[str, Any] = {"enabled": self.push_enabled}
+        if self.broker is not None:
+            push.update(self.broker.stats())
+            push.update(self.push_stats)
+        body["push"] = push
         return body
+
+    # ----------------------- score-on-ingest push ----------------------- #
+
+    async def _push_run(self) -> None:
+        """The push loop: every ``GORDO_PUSH_INTERVAL_S`` (event
+        seconds), score each dirty member's window rows past its last
+        pushed watermark and publish the result. Scoring goes through
+        the SAME batching engine the request path uses — concurrent
+        dirty members coalesce into the same device batches — but OFF
+        the request path: an ingest POST never waits on a score."""
+        while True:
+            await asyncio.sleep(
+                self.push_interval_s / max(1.0, self.clock.timescale)
+            )
+            with self._push_dirty_lock:
+                dirty, self._push_dirty = self._push_dirty, set()
+            if not dirty:
+                continue
+            outcomes = await asyncio.gather(
+                *(self._push_one(n) for n in sorted(dirty)),
+                return_exceptions=True,
+            )
+            for name, out in zip(sorted(dirty), outcomes):
+                if isinstance(out, asyncio.CancelledError):
+                    raise out
+                if isinstance(out, Exception):
+                    # one member's failure must not starve the others;
+                    # its rows stay unscored and retry with the next
+                    # advance (the watermark never moved)
+                    self.push_stats["publish_failed"] += 1
+                    logger.warning(
+                        "push scoring failed for %r", name, exc_info=out
+                    )
+
+    async def _push_one(self, name: str) -> None:
+        buf = self.ingestor.buffers.get(name)
+        det = self.app["collection"].models.get(name)
+        if buf is None or det is None:
+            return
+        ts, vals = buf.clean_window()
+        last = self._pushed_wm.get(name)
+        if last is not None:
+            keep = ts > last
+            ts, vals = ts[keep], vals[keep]
+        if not len(vals):
+            return
+        engine = self.app.get("bank_engine")
+        rows = np.ascontiguousarray(vals, np.float32)
+        if engine is not None and name in getattr(engine, "bank", ()):
+            result = await getattr(engine, "submit", engine.score)(name, rows)
+            total = np.asarray(result.total_scaled).ravel()
+        else:
+            total = await asyncio.get_running_loop().run_in_executor(
+                None, self._score_window_sync, det, rows
+            )
+        if total.size == 0:
+            # a sequence member's warm-up ate the whole increment: keep
+            # the watermark so these rows rejoin the next window
+            return
+        self.push_stats["windows_scored"] += 1
+        self._pushed_wm[name] = float(ts.max())
+        threshold = getattr(det, "total_threshold_", None)
+        threshold = None if threshold is None else float(threshold)
+        doc = {
+            "target": name,
+            "watermark": float(ts.max()),
+            "rows": int(len(vals)),
+            "scored": int(total.size),
+            "total_scaled": [float(v) for v in total],
+            "threshold": threshold,
+            "anomalies": (
+                None
+                if threshold is None
+                else int((total > threshold).sum())
+            ),
+            "at": self.clock.time(),
+        }
+        self.broker.publish(name, doc)
+
+    @staticmethod
+    def _score_window_sync(det, rows) -> np.ndarray:
+        """Per-model fallback scoring for non-banked members (executor
+        thread)."""
+        import pandas as pd
+
+        frame = det.anomaly(pd.DataFrame(rows))
+        return frame[("total-anomaly-scaled", "")].to_numpy().ravel()
 
     # --------------------------- adaptation ---------------------------- #
 
@@ -451,6 +607,10 @@ class StreamingPlane:
     def start(self) -> None:
         if self.auto and self._task is None:
             self._task = asyncio.get_running_loop().create_task(self._run())
+        if self.broker is not None and self._push_task is None:
+            self._push_task = asyncio.get_running_loop().create_task(
+                self._push_run()
+            )
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -458,6 +618,15 @@ class StreamingPlane:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
             self._task = None
+        if self._push_task is not None:
+            self._push_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._push_task
+            self._push_task = None
+        if self.broker is not None:
+            self.broker.close()
+        if self.poll_executor is not None:
+            self.poll_executor.shutdown(wait=False)
 
     async def _run(self) -> None:
         while True:

@@ -418,3 +418,105 @@ async def test_fetch_json_honors_http_date_retry_after():
     assert len(calls) == 2
     # the retry waited for the date hint (>=~1s), not the 0.01s backoff
     assert calls[1] - calls[0] >= 0.8
+
+
+# --------------------------------------------------------------------- #
+# connector sizing under hedging (ISSUE 13 satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_connector_limit_sized_for_hedging():
+    """The keep-alive pool must hold ``parallelism * (1 + hedge)`` lanes:
+    a hedged chunk keeps its primary socket open WHILE the hedge POST
+    runs on a second one. The old ``parallelism + 4`` cap made hedges
+    queue inside the connector behind the very primaries they were
+    escaping."""
+    base = dict(
+        base_url="http://localhost:1",
+        metadata_fallback_dataset={"type": "RandomDataset", "tag_list": ["a"]},
+    )
+    assert Client("p", parallelism=8, **base)._connector_limit() == 12
+    assert (
+        Client(
+            "p", parallelism=8, hedge=True,
+            replica_urls=["http://localhost:2"], **base,
+        )._connector_limit()
+        == 20
+    )
+    # tiny parallelism still keeps control-plane headroom
+    assert Client("p", parallelism=1, **base)._connector_limit() == 8
+
+
+async def test_hedged_run_opens_sockets_past_old_pool_cap():
+    """Regression (ISSUE 13 satellite): with every chunk slow enough to
+    hedge, the run needs parallelism primary sockets PLUS parallelism
+    hedge sockets concurrently. Counts distinct server-side transports
+    (one per client socket) across primary+replica and asserts the total
+    exceeds the old ``parallelism + 4`` cap that used to strangle the
+    hedge path."""
+    import asyncio as _asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    parallelism = 8
+    sockets = set()  # id(transport) per distinct connection, both servers
+
+    def app_for(role):
+        async def models(request):
+            return web.json_response(
+                {"models": ["m-1"], "accepts": ["application/json"]}
+            )
+
+        async def metadata(request):
+            return web.json_response({"endpoint-metadata": {}})
+
+        async def predict(request):
+            sockets.add(id(request.transport))
+            if role == "primary":
+                await _asyncio.sleep(0.6)  # slow: every chunk hedges
+            body = await request.json()
+            return web.json_response(
+                {"data": [[0.0]] * len(body["X"]), "index": body["index"]}
+            )
+
+        app = web.Application()
+        app.router.add_get("/gordo/v0/proj/models", models)
+        app.router.add_get("/gordo/v0/proj/{target}/metadata", metadata)
+        app.router.add_post(
+            "/gordo/v0/proj/{target}/anomaly/prediction", predict
+        )
+        return app
+
+    primary = TestServer(app_for("primary"))
+    replica = TestServer(app_for("replica"))
+    await primary.start_server()
+    await replica.start_server()
+    try:
+        client = Client(
+            "proj",
+            base_url=f"http://{primary.host}:{primary.port}",
+            batch_size=10,
+            parallelism=parallelism,
+            hedge=True,
+            replica_urls=[f"http://{replica.host}:{replica.port}"],
+            hedge_delay_init_s=0.05,
+            metadata_fallback_dataset={
+                "type": "RandomDataset",
+                "tag_list": ["a"],
+                "resolution": "1min",
+            },
+        )
+        results = await client.predict_async(
+            pd.Timestamp("2020-01-01 00:00:00Z"),
+            pd.Timestamp("2020-01-01 01:20:00Z"),  # 80 rows -> 8 chunks
+            targets=["m-1"],
+        )
+        assert results[0].ok, results[0].error_messages
+        assert client._hedge_stats["hedges"] >= parallelism // 2
+    finally:
+        await primary.close()
+        await replica.close()
+    # every chunk held a primary socket while its hedge opened another:
+    # the pool must have admitted more sockets than the old cap
+    assert len(sockets) > parallelism + 4, len(sockets)

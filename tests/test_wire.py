@@ -648,3 +648,115 @@ async def test_tensor_path_no_slower_than_json(artifact_dir):
         f"tensor path regressed below JSON: {t_tensor:.3f}s vs {t_json:.3f}s "
         f"for {posts} x {len(X)}-row anomaly POSTs"
     )
+
+
+# --------------------------------------------------------------------- #
+# cross-transport parity (ISSUE 13): tcp / uds / shm, identical bytes
+# --------------------------------------------------------------------- #
+
+
+# The same ``GTNS`` body over TCP, UDS, and the shm ring must yield
+# IDENTICAL bytes out. Posts are sequential (equal batch composition:
+# the repo's bitwise contract is per-composition; concurrent coalescing
+# may differ by ~1 ULP of XLA fusion drift), so this is the strict
+# byte-for-byte form. The UDS path — the same app behind a
+# ``web.UnixSite`` — must also keep the HTTP error surface: malformed
+# frames 400 with the reason, quarantined targets 410.
+
+
+@pytest.mark.saturate
+async def test_same_body_same_bytes_all_transports(artifact_dir, tmp_path):
+    import asyncio
+    import os
+
+    import aiohttp
+    from aiohttp import web as aioweb
+    from aiohttp.test_utils import TestServer
+
+    from gordo_components_tpu.server.transport import ShmServer
+    from gordo_components_tpu.utils.shm_ring import ShmRingClient
+
+    app = build_app(artifact_dir)
+    server = TestServer(app)
+    await server.start_server()
+    uds_path = str(tmp_path / "wire-parity.sock")
+    uds_site = aioweb.UnixSite(server.runner, uds_path)
+    await uds_site.start()
+    shm_name = f"gordo-wire-parity-{os.getpid()}"
+    shm_srv = ShmServer.create(app, shm_name, slots=2, slot_mb=1.0)
+    ring = ShmRingClient(shm_name)
+    loop = asyncio.get_running_loop()
+    try:
+        body = pack_frames([("X", _x(37, 3))])
+        path = "/gordo/v0/proj/wire-a/anomaly/prediction"
+        headers = {"Content-Type": TENSOR_CONTENT_TYPE}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://{server.host}:{server.port}{path}",
+                data=body, headers=headers,
+            ) as r:
+                assert r.status == 200, await r.text()
+                tcp_bytes = await r.read()
+        async with aiohttp.ClientSession(
+            connector=aiohttp.UnixConnector(path=uds_path)
+        ) as s:
+            async with s.post(
+                f"http://localhost{path}", data=body, headers=headers
+            ) as r:
+                assert r.status == 200, await r.text()
+                uds_bytes = await r.read()
+        status, shm_bytes = await loop.run_in_executor(
+            None, ring.request, "wire-a", body
+        )
+        assert status == 200
+        assert tcp_bytes == uds_bytes == shm_bytes
+        # and the parsed scores round-trip identically
+        frames = unpack_frames(shm_bytes)
+        assert frames["total-anomaly-scaled"].shape == (37,)
+    finally:
+        ring.close()
+        shm_srv.close()
+        await server.close()
+
+
+@pytest.mark.saturate
+async def test_uds_malformed_400_and_quarantine_410(artifact_dir, tmp_path):
+    import aiohttp
+    from aiohttp import web as aioweb
+    from aiohttp.test_utils import TestServer
+
+    app = build_app(artifact_dir)
+    server = TestServer(app)
+    await server.start_server()
+    uds_path = str(tmp_path / "wire-errors.sock")
+    await aioweb.UnixSite(server.runner, uds_path).start()
+    try:
+        path = "/gordo/v0/proj/wire-a/anomaly/prediction"
+        headers = {"Content-Type": TENSOR_CONTENT_TYPE}
+        async with aiohttp.ClientSession(
+            connector=aiohttp.UnixConnector(path=uds_path)
+        ) as s:
+            # truncated body -> 400 with the reason, over the socket
+            bad = pack_frames([("X", _x(8, 3))])[:-5]
+            async with s.post(
+                f"http://localhost{path}", data=bad, headers=headers
+            ) as r:
+                assert r.status == 400
+                assert "truncated" in await r.text()
+            # quarantined target -> 410 with the recorded reason
+            quarantine = app["quarantine"]
+            for _ in range(quarantine.threshold):
+                quarantine.record_failure("wire-a", "uds-test-poison")
+            body = pack_frames([("X", _x(8, 3))])
+            async with s.post(
+                f"http://localhost{path}", data=body, headers=headers
+            ) as r:
+                assert r.status == 410
+                assert "uds-test-poison" in await r.text()
+            quarantine.clear(["wire-a"])
+            async with s.post(
+                f"http://localhost{path}", data=body, headers=headers
+            ) as r:
+                assert r.status == 200
+    finally:
+        await server.close()
