@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -86,6 +86,18 @@ wire:
 saturate:
 	$(PYTHON) -m pytest tests/ -q -m saturate --continue-on-collection-errors
 
+# mesh lane: the multi-host serving plane — mesh bootstrap/partition,
+# watchman's versioned routing table (ETag polling, health stamps),
+# cross-replica member migration with zero non-200s under load (the
+# acquire -> route -> release sequence over both banks' hot-swaps),
+# routing edge cases (no owner -> 404 with reason, dual owner ->
+# bitwise-identical answers, empty fleet), the client's partition-aware
+# fan-out + stale-table reroute + health-gated hedging, and the fleet
+# placement tier's planner gates (tests/test_mesh.py; multi-process
+# coverage lives in the perfguard leg + tools/mesh_demo.py)
+mesh:
+	$(PYTHON) -m pytest tests/ -q -m mesh --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -99,10 +111,14 @@ hotloop:
 # tests/test_bank_quantized.py fused-kernel>=XLA-at-equal-dtype) PLUS
 # the tensor-path>=JSON-path wire guard (tests/test_wire.py) PLUS the
 # saturation guards (tests/test_saturate.py: multi-worker >= single
-# under mixed load, uds >= tcp) — the scoring pipeline must never
-# regress below the serial path it replaced, the fused kernel below the
-# XLA epilogue, the binary data plane below the JSON path it bypasses,
-# or the local transports below the TCP stack they bypass
+# under mixed load, uds >= tcp) PLUS the mesh fan-out guard
+# (tests/test_mesh.py: partition-aware routed client >= single-URL on a
+# real 2-process mesh; the parallel-win bound asserts only on
+# multi-core hosts) — the scoring pipeline must never regress below the
+# serial path it replaced, the fused kernel below the XLA epilogue, the
+# binary data plane below the JSON path it bypasses, the local
+# transports below the TCP stack they bypass, or the routing path below
+# naive broadcast
 perf-guard:
 	$(PYTHON) -m pytest tests/ -q -m "hotloop or perfguard" --continue-on-collection-errors
 
@@ -147,6 +163,14 @@ saturate-demo:
 # the same tool)
 replay-demo:
 	$(PYTHON) tools/replay_demo.py
+
+# true multi-process mesh: 2 partitioned server processes + a live
+# watchman routing table; prints single-vs-mesh rows/s (with cpu_count —
+# the parallel win needs real cores), fan-out per replica, and a live
+# cross-replica migration's zero-non-200 verdict (tools/mesh_demo.py;
+# bench.py's `mesh_serving` leg runs the same tool)
+mesh-demo:
+	$(PYTHON) tools/mesh_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -929,6 +929,69 @@ def bench_serving_saturation(rows=500, posts=40, workers=2, push_batches=8):
     }
 
 
+def bench_mesh_serving(models=8, rows=500, posts=16, replicas=2, concurrency=16):
+    """Multi-host serving mesh (ISSUE 14) — a REAL multi-process mesh:
+    N partitioned server processes + a live watchman routing table,
+    measured as (a) aggregate partition-aware bulk rows/s vs ONE replica
+    on the same member set, (b) bitwise cross-replica parity, (c) a live
+    cross-replica member migration under concurrent load with zero
+    non-200s. Subprocess via tools/mesh_demo.py (the children must boot
+    with their own GORDO_MESH_* env before jax imports).
+
+    The >=1.7x aggregate acceptance asserts only on multi-core hosts:
+    N server PROCESSES timesharing one core cannot beat one process
+    (measured ~0.6x here — the same honesty rule PR 13's multi-worker
+    leg documented), so on a single-core container the leg records the
+    ratio + cpu_count and asserts the structural guarantees instead."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "mesh_demo.py"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, tool, "--models", str(models), "--rows", str(rows),
+            "--posts", str(posts), "--replicas", str(replicas),
+            "--concurrency", str(concurrency),
+        ],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"mesh demo failed: {' | '.join(tail[-3:])}")
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    # structural acceptance: always asserted, any host
+    assert doc["parity"] == "bitwise", doc
+    assert all(int(v) > 0 for v in doc["requests_per_replica"].values()), doc
+    assert doc["migration"]["non_200"] == 0, doc["migration"]
+    assert doc["migration"]["requests_during"] > 0, doc["migration"]
+    single_core = (doc.get("cpu_count") or 1) < 2
+    if not single_core:
+        # the ISSUE 14 acceptance bar: aggregate rows/s across the mesh
+        # >= 1.7x one replica on the same member set
+        assert doc["mesh_vs_single"] >= 1.7, doc["mesh_vs_single"]
+    return {
+        "mesh_replicas": doc["replicas"],
+        "mesh_aggregate_rows_per_sec": doc["mesh"]["rows_per_sec"],
+        "mesh_single_replica_rows_per_sec": (
+            doc["single_replica"]["rows_per_sec"]
+        ),
+        "mesh_vs_single_replica": doc["mesh_vs_single"],
+        "mesh_single_core_container": single_core,
+        "mesh_cpu_count": doc.get("cpu_count"),
+        "mesh_requests_per_replica": doc["requests_per_replica"],
+        "mesh_migration_non_200": doc["migration"]["non_200"],
+        "mesh_migration_requests_during": doc["migration"]["requests_during"],
+        "mesh_migration_swap_pause_ms": {
+            "acquire": doc["migration"]["acquire_swap_pause_ms"],
+            "release": doc["migration"]["release_swap_pause_ms"],
+        },
+        "mesh_routing_version": doc["migration"]["routing_version"],
+        "mesh_serving": doc,
+    }
+
+
 def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
     """Config 5 extension — sequence models served from the HBM bank
     (windowing runs in-graph with the bucket's static lookback)."""
@@ -1467,6 +1530,7 @@ METRICS = (
     ("streaming", bench_streaming),
     ("replay", bench_replay),
     ("serving_saturation", bench_serving_saturation),
+    ("mesh_serving", bench_mesh_serving),
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
@@ -1496,6 +1560,7 @@ CPU_KWARGS = {
     "streaming": dict(members=4, rows=64, epochs=2),
     "replay": dict(epochs=2),
     "serving_saturation": dict(rows=300, posts=20, push_batches=5),
+    "mesh_serving": dict(models=6, rows=300, posts=10),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
     # the full 10k leg takes ~2.5 min on one core (measured; most of it

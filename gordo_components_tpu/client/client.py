@@ -118,6 +118,8 @@ class Client:
         hedge: bool = False,
         replica_urls: Optional[List[str]] = None,
         hedge_delay_init_s: float = 1.0,
+        routing_url: Optional[str] = None,
+        routing: Optional[Dict[str, Any]] = None,
     ):
         self.project = project
         # normalized (no trailing slash) so the hedge target exclusion
@@ -155,6 +157,22 @@ class Client:
         self._latency = _LatencyTracker()
         self._hedge_stats: Dict[str, int] = {"hedges": 0, "hedge_wins": 0}
         self._hedge_rng = random.Random()
+        # partition-aware fan-out (multi-host serving mesh): with a
+        # routing table — fetched from watchman's GET /routing when
+        # ``routing_url`` names the watchman base, or passed verbatim as
+        # ``routing`` — every member's chunks POST to the replica that
+        # OWNS it instead of one base URL, and hedges/fallbacks skip
+        # replicas the table marks degraded/unreachable (or that
+        # quarantine the member). Neither set: classic single-URL client,
+        # zero new code on the chunk path.
+        self.routing_url = (routing_url or "").rstrip("/") or None
+        self._routing: Optional[Dict[str, Any]] = None
+        self._routing_etag: Optional[str] = None
+        if routing is not None:
+            self._install_routing(routing)
+        self._fanout_stats: Dict[str, int] = {
+            "routed_chunks": 0, "routing_refreshes": 0, "reroutes": 0,
+        }
         # request-body encoding for scoring POSTs: "auto" upgrades to
         # parquet when the server advertises it (JSON float-list
         # encode/decode dominates at fleet-backfill scale — the reference's
@@ -278,6 +296,23 @@ class Client:
                 "Stream rows the ingestion forwarder posted and the "
                 "server accepted", labels, c._ingest_stats["rows"],
             )
+            yield (
+                "gordo_client_routed_chunks_total", "counter",
+                "Scoring chunks routed to their member's owning replica "
+                "via the mesh routing table", labels,
+                c._fanout_stats["routed_chunks"],
+            )
+            yield (
+                "gordo_client_routing_refreshes_total", "counter",
+                "Routing-table fetches that installed a new table "
+                "(200s; 304 not-modified polls excluded)", labels,
+                c._fanout_stats["routing_refreshes"],
+            )
+            yield (
+                "gordo_client_reroutes_total", "counter",
+                "Chunks re-posted after a stale-table 404 forced a "
+                "routing refresh", labels, c._fanout_stats["reroutes"],
+            )
             for enc, st in list(c._wire_stats.items()):
                 yield (
                     "gordo_client_request_bytes_total", "counter",
@@ -309,9 +344,135 @@ class Client:
         """Replica base URLs from a watchman ``GET /`` snapshot body
         (the ``replicas`` list watchman derives from its scrape
         targets) — the hedging target list, fetched from the component
-        that already tracks which replicas exist."""
-        urls = snapshot.get("replicas") or []
-        return [u.rstrip("/") for u in urls if isinstance(u, str) and u]
+        that already tracks which replicas exist. Accepts both forms:
+        bare URL strings (pre-mesh watchman) and the stamped entry
+        objects (``{"url": ..., "routing_version": ..., "status": ...}``)
+        the routing plane serves now."""
+        out: List[str] = []
+        for entry in snapshot.get("replicas") or []:
+            if isinstance(entry, dict):
+                entry = entry.get("url")
+            if isinstance(entry, str) and entry.rstrip("/"):
+                out.append(entry.rstrip("/"))
+        return out
+
+    # ------------------------------------------------------------------ #
+    # partition-aware fan-out (the mesh routing table)
+    # ------------------------------------------------------------------ #
+
+    def _install_routing(self, table: Dict[str, Any]) -> None:
+        """Validate + index a routing table body (watchman ``GET
+        /routing``): member -> owner index, index -> replica entry."""
+        if not isinstance(table, dict) or not isinstance(
+            table.get("members"), dict
+        ):
+            raise ValueError(
+                "routing table must be a dict with a 'members' map "
+                "(watchman GET /routing body)"
+            )
+        replicas = {
+            int(r["replica"]): {**r, "url": str(r["url"]).rstrip("/")}
+            for r in table.get("replicas") or []
+            if isinstance(r, dict) and "replica" in r and r.get("url")
+        }
+        self._routing = {
+            "version": int(table.get("version", 0)),
+            "members": dict(table["members"]),
+            # member -> ALL replica indices serving it right now (the
+            # table's multi-owner view: mid-migration overlap, or a
+            # fully replicated fleet) — the hedge candidate set
+            "owners": {
+                str(k): [int(i) for i in v]
+                for k, v in (table.get("migrating") or {}).items()
+                if isinstance(v, (list, tuple))
+            },
+            "replicas": replicas,
+        }
+
+    @property
+    def routing_version(self) -> Optional[int]:
+        return self._routing["version"] if self._routing else None
+
+    async def _fetch_routing(self, session, force: bool = False) -> bool:
+        """Fetch/refresh the routing table from watchman. ETag-
+        conditional: an unchanged table costs a 304 and keeps the local
+        index. Returns True when the local table CHANGED. Best-effort —
+        a watchman outage downgrades the run to single-URL posting (the
+        configured base_url) rather than failing it."""
+        if self.routing_url is None:
+            return False
+        headers = {}
+        if self._routing_etag and not force:
+            headers["If-None-Match"] = self._routing_etag
+        try:
+            async with session.get(
+                f"{self.routing_url}/routing",
+                params={"refresh": "1"} if force else None,
+                headers=headers,
+            ) as resp:
+                if resp.status == 304:
+                    return False
+                if resp.status != 200:
+                    logger.warning(
+                        "routing fetch answered %d; keeping %s",
+                        resp.status,
+                        "previous table" if self._routing else "single-URL mode",
+                    )
+                    return False
+                body = await resp.json()
+                etag = resp.headers.get("ETag")
+        except Exception as exc:
+            logger.warning(
+                "routing fetch from %s failed (%s); %s", self.routing_url,
+                exc,
+                "keeping previous table" if self._routing
+                else "single-URL mode",
+            )
+            return False
+        before = self._routing["version"] if self._routing else None
+        try:
+            # best-effort by contract: a 200 with an unexpected shape (a
+            # proxy's JSON error page, a pre-mesh watchman) must downgrade
+            # like any other fetch failure, not abort the scoring run —
+            # and must NOT record the ETag, or conditional 304s would pin
+            # the client table-less forever while it believes it is polling
+            self._install_routing(body)
+        except ValueError as exc:
+            logger.warning(
+                "routing body from %s unusable (%s); %s", self.routing_url,
+                exc,
+                "keeping previous table" if self._routing
+                else "single-URL mode",
+            )
+            return False
+        self._routing_etag = etag
+        self._fanout_stats["routing_refreshes"] += 1
+        return self._routing["version"] != before
+
+    def _member_base_url(self, target: str) -> Optional[str]:
+        """The owning replica's base URL for a member, or None (member
+        unknown to the table, owner entry missing, or no table) — the
+        caller falls back to the configured base_url, whose server
+        answers 404 with the reason if truly nobody serves it."""
+        if self._routing is None:
+            return None
+        idx = self._routing["members"].get(target)
+        if idx is None:
+            return None
+        rep = self._routing["replicas"].get(int(idx))
+        return rep["url"] if rep else None
+
+    def _replica_healthy_for(self, rep: Dict[str, Any], target: str) -> bool:
+        """Hedge/fallback eligibility from the routing table's stamps: a
+        replica marked unreachable, degraded, or unhealthy — or one that
+        QUARANTINES this member — must never receive a hedge (the old
+        behavior hedged to any other replica, so a hedge could land on
+        exactly the sick replica it was escaping)."""
+        if not rep.get("reachable", True):
+            return False
+        if rep.get("status", "ok") not in ("ok",):
+            return False
+        return target not in (rep.get("quarantined") or ())
 
     def _connector_limit(self) -> int:
         """Keep-alive pool size for the scoring session. Hedged chunks
@@ -336,7 +497,16 @@ class Client:
 
     def _chunk_urls(self, target: str, endpoint: str) -> List[str]:
         """Primary URL plus (hedging only) ONE alternate replica's URL
-        for the same path."""
+        for the same path.
+
+        With a routing table the primary is the member's OWNING replica
+        (partition-aware fan-out: each chunk goes where the model's
+        weights are resident), and the hedge alternate is drawn only
+        from replicas the table marks healthy that also serve the member
+        — in a partitioned fleet that usually means a mid-migration
+        dual owner; a replica that doesn't hold the member, is
+        degraded/unreachable, or quarantines it can only lose (or
+        mis-404) the hedge."""
         if self._data_session is not None:
             # UDS session: the path is the address (the connector owns
             # the socket); hedging is TCP-replica machinery and a local
@@ -344,14 +514,32 @@ class Client:
             return [
                 f"http://localhost/gordo/v0/{self.project}/{target}/{endpoint}"
             ]
+        path = f"gordo/v0/{self.project}/{target}/{endpoint}"
+        if self._routing is not None:
+            primary = self._member_base_url(target) or self.base_url
+            urls = [f"{primary}/{path}"]
+            if self.hedge:
+                # healthy replicas that actually SERVE this member (the
+                # table's multi-owner set: mid-migration overlap, or a
+                # replicated fleet) — never the sick-replica or
+                # wrong-partition hedge the pre-routing client could
+                # issue
+                candidates = [
+                    rep["url"]
+                    for idx in self._routing["owners"].get(target, ())
+                    if (rep := self._routing["replicas"].get(idx)) is not None
+                    and rep["url"] != primary
+                    and self._replica_healthy_for(rep, target)
+                ]
+                if candidates:
+                    urls.append(f"{self._hedge_rng.choice(candidates)}/{path}")
+            return urls
         urls = [self._url(target, endpoint)]
         if self.hedge:
             others = [u for u in self.replica_urls if u != self.base_url]
             if others:
                 alt = self._hedge_rng.choice(others)
-                urls.append(
-                    f"{alt}/gordo/v0/{self.project}/{target}/{endpoint}"
-                )
+                urls.append(f"{alt}/{path}")
         return urls
 
     @staticmethod
@@ -524,20 +712,44 @@ class Client:
     # ------------------------------------------------------------------ #
 
     def _url(self, target: str, endpoint: str) -> str:
-        return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
+        # control-plane lookups follow the routing table too: in a
+        # partitioned mesh only the OWNER can answer a member's
+        # /metadata (the configured base_url would 404 the other
+        # partitions' members)
+        base = self._member_base_url(target) or self.base_url
+        return f"{base}/gordo/v0/{self.project}/{target}/{endpoint}"
 
     async def _get_metadata(self, session, target: str) -> Dict[str, Any]:
         meta = self._metadata_all.get(target)
         if meta is not None:
             return meta
-        body = await fetch_json(
-            session,
-            self._url(target, "metadata"),
-            retries=self.retries,
-            backoff=self.backoff,
-            retry_budget=self.retry_budget,
-        )
-        return body.get("endpoint-metadata", {})
+
+        async def fetch():
+            body = await fetch_json(
+                session,
+                self._url(target, "metadata"),
+                retries=self.retries,
+                backoff=self.backoff,
+                retry_budget=self.retry_budget,
+            )
+            return body.get("endpoint-metadata", {})
+
+        try:
+            return await fetch()
+        except ValueError as exc:
+            # routed 404: the member may have MOVED since our table
+            # (stale-table detection, same rule as the scoring path) —
+            # one forced refetch, one retry against the new owner
+            if self._routing is None or "404" not in str(exc):
+                raise
+            if not await self._fetch_routing(session, force=True):
+                raise
+            logger.warning(
+                "routing table was stale (now v%s); refetching metadata "
+                "for %s", self.routing_version, target,
+            )
+            self._fanout_stats["reroutes"] += 1
+            return await fetch()
 
     async def _prefetch_metadata(self, session) -> None:
         """Prefetch every target's metadata in ONE request via the
@@ -545,15 +757,34 @@ class Client:
         scale the per-target ``/metadata`` round-trips otherwise cost N
         requests before any scoring starts. Best-effort with a short
         deadline and shape validation (shared helper, client/io.py):
-        foreign servers keep the per-target path."""
-        body = await fetch_metadata_all(session, self.base_url, self.project)
-        if body is None:
-            return
-        self._metadata_all = {
-            name: entry["endpoint-metadata"]
-            for name, entry in body["targets"].items()
-            if isinstance(entry, dict) and "endpoint-metadata" in entry
-        }
+        foreign servers keep the per-target path.
+
+        Partitioned mesh: ONE metadata-all per replica (each holds only
+        its partition's metadata), merged — still O(replicas), not
+        O(members), requests."""
+        bases = [self.base_url]
+        if self._routing is not None:
+            routed = [
+                rep["url"]
+                for rep in self._routing["replicas"].values()
+                if rep.get("reachable", True)
+            ]
+            bases = routed or bases
+        bodies = await asyncio.gather(
+            *(fetch_metadata_all(session, b, self.project) for b in bases)
+        )
+        merged: Dict[str, Any] = {}
+        for body in bodies:
+            if body is None:
+                continue
+            merged.update(
+                {
+                    name: entry["endpoint-metadata"]
+                    for name, entry in body["targets"].items()
+                    if isinstance(entry, dict) and "endpoint-metadata" in entry
+                }
+            )
+        self._metadata_all = merged
 
     def _dataset_config_from_metadata(self, meta, start, end) -> Dict[str, Any]:
         ds_meta = meta.get("dataset", {})
@@ -606,6 +837,12 @@ class Client:
         async with aiohttp.ClientSession(
             timeout=timeout, connector=connector
         ) as session:
+            # partition-aware fan-out: learn the routing table BEFORE
+            # discovery — in a mesh the configured base_url is one
+            # replica and its /models lists only its own partition, so
+            # the table (union over the fleet) is the real target roster
+            if self.routing_url is not None:
+                await self._fetch_routing(session)
             models_body = None
             if (
                 targets is None
@@ -622,11 +859,20 @@ class Client:
                         retry_budget=self.retry_budget,
                     )
                 except Exception:
-                    if targets is None:  # discovery is mandatory
-                        raise
+                    if targets is None and not (
+                        self._routing and self._routing["members"]
+                    ):
+                        raise  # discovery is mandatory without a table
                     models_body = None  # encoding probe is best-effort
             if targets is None:
-                targets = models_body["models"]
+                if self._routing is not None and self._routing["members"]:
+                    targets = sorted(self._routing["members"])
+                else:
+                    # a VALID-but-empty table (fleet still booting,
+                    # replicas momentarily unreachable) must not quietly
+                    # score nothing: the base replica's /models is live
+                    # discovery truth we already fetched
+                    targets = models_body["models"]
             # fresh per run: stale cached metadata must never outlive a
             # server-side /reload (a failed re-prefetch then falls back to
             # per-target fetches, not to last run's cache)
@@ -659,7 +905,19 @@ class Client:
                         "use_parquet=True but no parquet engine "
                         "(pyarrow/fastparquet) is installed"
                     )
-            await self._resolve_transport(models_body)
+            if (
+                self._routing is not None
+                and len(self._routing["replicas"]) > 1
+            ):
+                # fan-out across replicas rides TCP: the uds/shm rungs
+                # address ONE co-located server, and pinning every
+                # routed chunk to a local socket would undo the
+                # partition routing the table exists for
+                self.transport_used = "tcp"
+                self._shm_client = None
+                self._data_session = None
+            else:
+                await self._resolve_transport(models_body)
             try:
                 results = await asyncio.gather(
                     *(
@@ -863,6 +1121,17 @@ class Client:
 
         async def post_chunk(chunk: pd.DataFrame, chunk_y: Optional[pd.DataFrame]):
             async with sem:
+                # routed-chunk accounting lives HERE, once per chunk
+                # attempt — _chunk_urls runs once per encoding rung
+                # (tensor -> parquet -> JSON downgrades), which would
+                # count one chunk several times and skew the
+                # routed-vs-fallback split the replica-loss runbook
+                # reads. A no-owner fallback to base_url never counts.
+                if (
+                    self._routing is not None
+                    and self._member_base_url(target) is not None
+                ):
+                    self._fanout_stats["routed_chunks"] += 1
                 # one id per chunk, reused across the tensor/parquet ->
                 # JSON downgrade re-posts: every attempt is the SAME
                 # request. Likewise ONE deadline: a downgrade re-post
@@ -1108,6 +1377,31 @@ class Client:
             for i in range(0, len(X), self.batch_size)
         ]
         bodies = await asyncio.gather(*(post_chunk(cx, cy) for cx, cy in chunks))
+        if (
+            self._routing is not None
+            and any(b is None for b in bodies)
+            and any("No such model" in e for e in errors)
+        ):
+            # stale-table detection: a routed chunk 404ing means the
+            # member moved since our table (watchman stamps the version
+            # for exactly this). Refetch once; a CHANGED table re-posts
+            # every failed chunk to the new owner — one bounded retry,
+            # not a loop (an unchanged table means the member truly has
+            # no owner, and the 404-with-reason stands as the answer)
+            if await self._fetch_routing(session, force=True):
+                retry = [i for i, b in enumerate(bodies) if b is None]
+                self._fanout_stats["reroutes"] += len(retry)
+                logger.warning(
+                    "routing table was stale (now v%s); re-posting %d "
+                    "chunk(s) for %s", self.routing_version, len(retry),
+                    target,
+                )
+                errors.clear()
+                fresh = await asyncio.gather(
+                    *(post_chunk(*chunks[i]) for i in retry)
+                )
+                for i, body in zip(retry, fresh):
+                    bodies[i] = body
         for body in bodies:
             if body is None:
                 continue

@@ -24,6 +24,7 @@ here at 1/N the process count.
 
 import logging
 import os
+from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -143,3 +144,128 @@ def partition_members(
     ordered = sorted(names)
     start, stop = process_member_slice(len(ordered), process_id, process_count)
     return ordered[start:stop]
+
+
+# --------------------------------------------------------------------- #
+# serving mesh (multi-host serving plane)
+# --------------------------------------------------------------------- #
+#
+# Training gangs above share one XLA program across hosts; the SERVING
+# mesh deliberately does not. Each serving replica owns a disjoint member
+# partition in its own HBM and answers only for those members — the
+# cross-replica plane is HTTP (watchman's routing table + the client's
+# partition-aware fan-out), not collectives, because a scoring request
+# for member m needs exactly one replica's devices. jax.distributed is
+# still bootstrapped on request (GORDO_MESH_DISTRIBUTED=1): a pod-slice
+# deploy wants the shared coordinator for device health and allgather-
+# style control ops, but a CPU rig (or plain multi-process-per-host
+# serving) runs the same mesh with N independent JAX runtimes.
+
+
+@dataclass(frozen=True)
+class MeshIdentity:
+    """This serving process's place in the fleet mesh."""
+
+    replica_id: int
+    replica_count: int
+    coordinator: Optional[str] = None
+    distributed: bool = False  # jax multi-controller runtime actually up
+
+    def partition(self, names: Sequence[str]) -> List[str]:
+        """The member names this replica boots owning (the deterministic
+        contiguous slice — every replica computes the same split from
+        the same artifact dir without communicating). Boot-time only:
+        live ownership then evolves via mesh acquire/release."""
+        return partition_members(names, self.replica_id, self.replica_count)
+
+
+def serving_mesh_identity(
+    replica_id: Optional[int] = None,
+    replica_count: Optional[int] = None,
+) -> Optional[MeshIdentity]:
+    """Resolve this process's mesh identity, or None outside mesh mode.
+
+    Resolution per field: explicit kwarg -> ``GORDO_MESH_REPLICA_ID`` /
+    ``GORDO_MESH_REPLICAS`` env. Mesh mode requires BOTH: a replica that
+    knows its index but not the fleet size (or vice versa) cannot compute
+    its partition, and guessing would double- or zero-assign members —
+    so a half-configured mesh fails loudly here instead of serving the
+    wrong slice."""
+
+    def env_int(name: str) -> Optional[int]:
+        raw = os.environ.get(name)
+        if raw is None or raw == "":
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+    if replica_id is None:
+        replica_id = env_int("GORDO_MESH_REPLICA_ID")
+    if replica_count is None:
+        replica_count = env_int("GORDO_MESH_REPLICAS")
+    if replica_id is None and replica_count is None:
+        return None
+    if replica_id is None or replica_count is None:
+        raise ValueError(
+            "mesh mode needs BOTH GORDO_MESH_REPLICA_ID and "
+            f"GORDO_MESH_REPLICAS (got replica_id={replica_id}, "
+            f"replicas={replica_count})"
+        )
+    if replica_count < 1:
+        raise ValueError(f"GORDO_MESH_REPLICAS must be >= 1, got {replica_count}")
+    if not 0 <= replica_id < replica_count:
+        raise ValueError(
+            f"GORDO_MESH_REPLICA_ID {replica_id} not in [0, {replica_count})"
+        )
+    return MeshIdentity(
+        replica_id=replica_id,
+        replica_count=replica_count,
+        coordinator=os.environ.get("GORDO_MESH_COORDINATOR") or None,
+    )
+
+
+def bootstrap_serving_mesh(
+    replica_id: Optional[int] = None,
+    replica_count: Optional[int] = None,
+) -> Optional[MeshIdentity]:
+    """Serving-side mesh bootstrap (build_app calls this once at boot).
+
+    Returns the resolved :class:`MeshIdentity`, or None when the process
+    is not part of a mesh (the single-replica default — zero new code
+    runs). ``GORDO_MESH_DISTRIBUTED=1`` additionally wires the replicas
+    into one JAX multi-controller group via :func:`initialize_distributed`
+    (coordinator from ``GORDO_MESH_COORDINATOR``); a failed rendezvous
+    degrades to local-runtime mode with a loud log instead of refusing
+    to serve — the HTTP routing plane works either way, and a replica
+    that can score its partition must not crashloop because a peer is
+    slow to start."""
+    identity = serving_mesh_identity(replica_id, replica_count)
+    if identity is None:
+        return None
+    if os.environ.get("GORDO_MESH_DISTRIBUTED", "0") in ("1", "true", "yes"):
+        try:
+            initialize_distributed(
+                coordinator_address=identity.coordinator,
+                num_processes=identity.replica_count,
+                process_id=identity.replica_id,
+            )
+            identity = MeshIdentity(
+                replica_id=identity.replica_id,
+                replica_count=identity.replica_count,
+                coordinator=identity.coordinator,
+                distributed=True,
+            )
+        except Exception:
+            logger.warning(
+                "GORDO_MESH_DISTRIBUTED=1 but the jax.distributed "
+                "rendezvous failed; replica %d/%d serves its partition on "
+                "a local runtime (HTTP routing plane unaffected)",
+                identity.replica_id, identity.replica_count, exc_info=True,
+            )
+    logger.info(
+        "serving mesh: replica %d of %d (distributed runtime: %s)",
+        identity.replica_id, identity.replica_count, identity.distributed,
+    )
+    return identity

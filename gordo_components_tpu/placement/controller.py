@@ -220,6 +220,14 @@ class PlacementController:
                 "members_with_traffic": len(loads),
                 "skew_ratio": self.observed_skew(),
             },
+            # per-member window loads (routed rows since the last applied
+            # plan): the FLEET placement tier's signal — watchman fetches
+            # this from every replica and feeds plan_fleet, so which
+            # replica owns each member is decided on the same windowed
+            # counters the intra-host planner already uses. Only members
+            # with traffic appear (bounded by the active set, not the
+            # fleet roster).
+            "member_rows": {name: int(v) for name, v in loads.items()},
             "stats": dict(self.stats),
         }
         if bank is not None:

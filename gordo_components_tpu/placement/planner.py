@@ -279,3 +279,233 @@ def plan_rebalance(
         f"predicted skew {skew_before:.2f} -> {skew_after:.2f} "
         f"({improvement:.2f}x improvement, {moved} member(s) move)",
     )
+
+
+# ---------------------------------------------------------------------- #
+# fleet tier (multi-host serving mesh): which REPLICA owns each member
+# ---------------------------------------------------------------------- #
+#
+# The intra-host tier above permutes members between a bank's shards —
+# free to apply (one local rebuild + flip). The fleet tier moves members
+# between REPLICAS, which costs an artifact ship plus a bank rebuild on
+# BOTH sides — so it plans few, high-value moves (bounded by max_moves)
+# instead of a full LPT reshuffle, and it must never target a replica
+# that is degraded, unreachable, or burning its SLO budget: handing a
+# hot member to a sick replica converts a skew problem into an outage.
+
+
+def default_fleet_threshold() -> float:
+    """Improvement factor a fleet plan must predict before it applies
+    (``GORDO_MESH_THRESHOLD``; falls back to the intra-host rebalance
+    threshold so one tuned hysteresis covers both tiers unless the
+    operator splits them)."""
+    return _env_float("GORDO_MESH_THRESHOLD", default_threshold())
+
+
+def default_max_moves() -> int:
+    """Cross-replica moves per plan (``GORDO_MESH_MAX_MOVES``): each
+    move ships an artifact and rebuilds two banks, so the default keeps
+    a single plan's disruption small and lets the watchman loop converge
+    over several evaluations instead of one big bang."""
+    return int(_env_float("GORDO_MESH_MAX_MOVES", 4))
+
+
+@dataclass
+class FleetMove:
+    """One planned cross-replica ownership change."""
+
+    member: str
+    src: int  # replica index losing the member
+    dst: int  # replica index gaining it
+    rows: float  # the member's observed window load
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "member": self.member,
+            "src": self.src,
+            "dst": self.dst,
+            "rows": int(self.rows),
+        }
+
+
+@dataclass
+class FleetPlan:
+    """A fleet-tier plan: ordered moves plus the verdict."""
+
+    moves: List[FleetMove]
+    replica_rows_before: Dict[int, float]
+    replica_rows_after: Dict[int, float]
+    skew_before: Optional[float]
+    skew_after: Optional[float]
+    improvement: Optional[float]
+    threshold: float
+    should_apply: bool
+    reason: str
+    observed_rows: int
+    eligible: List[int]  # replicas eligible as move DESTINATIONS
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "should_apply": self.should_apply,
+            "reason": self.reason,
+            "moves": [m.summary() for m in self.moves],
+            "replica_rows_before": {
+                str(k): round(v, 1)
+                for k, v in sorted(self.replica_rows_before.items())
+            },
+            "replica_rows_after": {
+                str(k): round(v, 1)
+                for k, v in sorted(self.replica_rows_after.items())
+            },
+            "skew_before": _r(self.skew_before),
+            "skew_after": _r(self.skew_after),
+            "improvement": _r(self.improvement),
+            "threshold": self.threshold,
+            "observed_rows": self.observed_rows,
+            "eligible": list(self.eligible),
+        }
+
+
+def plan_fleet(
+    members_by_replica: Mapping[int, Sequence[str]],
+    loads: Mapping[str, float],
+    replica_health: Optional[Mapping[int, str]] = None,
+    threshold: Optional[float] = None,
+    min_rows: int = 0,
+    max_moves: Optional[int] = None,
+) -> FleetPlan:
+    """Plan cross-replica member moves over the fleet's observed loads.
+
+    ``members_by_replica``: the routing plane's observed ownership
+    (watchman builds it from each replica's ``/models``). ``loads``:
+    member -> routed rows over the decision window (fleet-rolled from
+    each replica's ``/placement`` ``member_rows``). ``replica_health``:
+    replica -> ``"ok" | "degraded" | "unhealthy" | "unreachable" |
+    "burning"`` — only ``"ok"`` replicas are eligible move DESTINATIONS
+    (any replica may be a source: evacuating a sick replica is exactly
+    the point), absent entries default to ok.
+
+    Deterministic greedy descent: while the hottest replica exceeds the
+    coolest eligible replica, move the largest member whose relocation
+    shrinks the gap (load <= gap, largest-first, name tiebreak). Skew is
+    max/mean of per-replica rows — the same definition the shard tier
+    uses, one level up. The plan is advisory: ``should_apply`` encodes
+    the verdict, watchman decides."""
+    if threshold is None:
+        threshold = default_fleet_threshold()
+    if max_moves is None:
+        max_moves = default_max_moves()
+    health = dict(replica_health or {})
+    replicas = sorted(members_by_replica)
+    owner: Dict[str, int] = {}
+    for rid in replicas:
+        for name in members_by_replica[rid]:
+            # dual ownership mid-migration resolves to the lowest index
+            # here; the planner only needs a consistent single owner
+            owner.setdefault(name, rid)
+    rows_now: Dict[int, float] = {
+        rid: sum(float(loads.get(n, 0.0)) for n in members_by_replica[rid] if owner[n] == rid)
+        for rid in replicas
+    }
+    eligible = [rid for rid in replicas if health.get(rid, "ok") == "ok"]
+    observed_rows = int(sum(float(v) for v in loads.values()))
+    before = dict(rows_now)
+    skew_before = skew_ratio(list(before.values()))
+
+    def verdict(
+        moves: List[FleetMove], should: bool, reason: str
+    ) -> FleetPlan:
+        after = dict(rows_now)
+        skew_after = skew_ratio(list(after.values()))
+        improvement = (
+            skew_before / skew_after
+            if skew_before is not None and skew_after not in (None, 0.0)
+            else None
+        )
+        return FleetPlan(
+            moves=moves,
+            replica_rows_before=before,
+            replica_rows_after=after,
+            skew_before=skew_before,
+            skew_after=skew_after,
+            improvement=improvement,
+            threshold=float(threshold),
+            should_apply=should,
+            reason=reason,
+            observed_rows=observed_rows,
+            eligible=eligible,
+        )
+
+    if len(replicas) < 2:
+        return verdict([], False, "fewer than two replicas (nothing to move between)")
+    if observed_rows < min_rows:
+        return verdict(
+            [],
+            False,
+            f"insufficient load signal ({observed_rows} routed rows "
+            f"observed, need >= {min_rows})",
+        )
+    if not eligible:
+        return verdict(
+            [], False, "no healthy replica eligible as a move destination"
+        )
+    if skew_before is None:
+        return verdict([], False, "no routed-row signal on any replica")
+
+    moves: List[FleetMove] = []
+    moved_members = set()
+    while len(moves) < max_moves:
+        src = max(replicas, key=lambda r: (rows_now[r], -r))
+        dst_candidates = [r for r in eligible if r != src]
+        if not dst_candidates:
+            break
+        dst = min(dst_candidates, key=lambda r: (rows_now[r], r))
+        gap = rows_now[src] - rows_now[dst]
+        if gap <= 0:
+            break
+        # largest member STRICTLY under the gap: moving load L turns the
+        # src-dst gap into gap - 2L, and |gap - 2L| < gap iff 0 < L < gap
+        # — so every accepted move strictly shrinks the pair's gap, and
+        # because src is the fleet max, max/mean skew never increases
+        # (L == gap would just swap which replica is hot: thrash)
+        candidates = sorted(
+            (
+                n
+                for n in members_by_replica[src]
+                if owner[n] == src
+                and n not in moved_members
+                and 0 < float(loads.get(n, 0.0)) < gap
+            ),
+            key=lambda n: (-float(loads.get(n, 0.0)), n),
+        )
+        if not candidates:
+            break
+        name = candidates[0]
+        rows = float(loads.get(name, 0.0))
+        moves.append(FleetMove(member=name, src=src, dst=dst, rows=rows))
+        moved_members.add(name)
+        owner[name] = dst
+        rows_now[src] -= rows
+        rows_now[dst] += rows
+
+    if not moves:
+        return verdict([], False, "placement already balanced (no improving move)")
+    # one derivation: verdict() computes skew_after/improvement from
+    # rows_now, and the threshold decision reads the SAME values off the
+    # plan — two parallel formulas here could silently disagree with
+    # what summary() reports
+    plan = verdict(moves, False, "")
+    if plan.improvement is None or plan.improvement < threshold:
+        plan.reason = (
+            f"predicted improvement "
+            f"{plan.improvement if plan.improvement is None else round(plan.improvement, 2)}x "
+            f"below threshold {threshold:.2f}x"
+        )
+        return plan
+    plan.should_apply = True
+    plan.reason = (
+        f"predicted replica skew {plan.skew_before:.2f} -> "
+        f"{plan.skew_after:.2f} ({plan.improvement:.2f}x improvement, "
+        f"{len(moves)} cross-replica move(s))"
+    )
+    return plan

@@ -521,7 +521,28 @@ def build_app(
         app["slo"] = SLOTracker(
             ledger, registry=registry, clock=app["clock"].monotonic
         )
-    collection = ModelCollection(model_dir, target_name=target_name)
+    # multi-host serving mesh (parallel/distributed.py): with
+    # GORDO_MESH_REPLICA_ID/GORDO_MESH_REPLICAS set, this process is one
+    # replica of a fleet mesh and loads ONLY its deterministic member
+    # partition from the (typically shared) artifact dir — watchman's
+    # routing table points clients at the owning replica, and the mesh
+    # acquire/release endpoints (views.py) move members between replicas
+    # live. Unset (the default): unpartitioned, zero new code runs.
+    from gordo_components_tpu.parallel.distributed import bootstrap_serving_mesh
+    from gordo_components_tpu.server.model_io import scan_artifacts
+
+    mesh_identity = bootstrap_serving_mesh()
+    owned = None
+    if mesh_identity is not None:
+        roster = sorted(scan_artifacts(model_dir, target_name))
+        owned = mesh_identity.partition(roster)
+        logger.info(
+            "mesh replica %d/%d owns %d of %d member(s)",
+            mesh_identity.replica_id, mesh_identity.replica_count,
+            len(owned), len(roster),
+        )
+    app["mesh"] = mesh_identity
+    collection = ModelCollection(model_dir, target_name=target_name, owned=owned)
     app["collection"] = collection
     # per-model scoring-failure breaker (resilience/quarantine.py): a
     # model that keeps failing or emitting NaN is evicted from routing

@@ -13,9 +13,11 @@ arrays the bank scores (``np.frombuffer`` view, no DataFrame), and encode
 score arrays straight into one preallocated response body (utils/wire.py).
 """
 
+import io
 import json
 import logging
 import os
+import tarfile
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -112,6 +114,62 @@ def anomaly_frame_arrays(frame) -> Dict[str, np.ndarray]:
 _FP_LOAD = faultpoint("model_io.load")
 
 
+def pack_artifact_dir(path: str) -> bytes:
+    """One member's artifact dir as a gzipped tar (the cross-replica
+    shipping format for mesh migrations). Paths inside the archive are
+    relative to the dir, so the receiver lands them under its own root
+    regardless of the sender's layout."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for entry in sorted(os.listdir(path)):
+            tar.add(os.path.join(path, entry), arcname=entry)
+    return buf.getvalue()
+
+
+def unpack_artifact_dir(raw: bytes, dest: str) -> None:
+    """Extract a shipped artifact archive under ``dest``, validating
+    every member name first — the archive crosses a network boundary, so
+    absolute paths, ``..`` traversal, links, and devices are rejected
+    outright (a hostile or corrupted archive must not write outside the
+    member's own dir)."""
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(raw), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            name = member.name
+            if (
+                os.path.isabs(name)
+                or ".." in name.split("/")
+                or not (member.isfile() or member.isdir())
+            ):
+                raise ValueError(
+                    f"refusing artifact archive member {name!r} "
+                    "(unsafe path or non-file entry)"
+                )
+        for member in tar.getmembers():
+            tar.extract(member, dest, set_attrs=False)
+
+
+def scan_artifacts(root: str, target_name: Optional[str] = None) -> Dict[str, str]:
+    """name -> artifact dir for the on-disk state under ``root`` (a
+    single artifact dir, or a dir of artifact subdirs). Module-level so
+    the mesh bootstrap can compute the FULL fleet roster — every replica
+    must partition the same global member list — before the collection
+    filters down to this replica's slice."""
+    if os.path.exists(os.path.join(root, "model.pkl")):
+        name = target_name or os.path.basename(os.path.normpath(root))
+        return {name: root}
+    out = {}
+    try:
+        entries = sorted(os.listdir(root))
+    except FileNotFoundError:
+        return {}
+    for entry in entries:
+        path = os.path.join(root, entry)
+        if os.path.isdir(path) and os.path.exists(os.path.join(path, "model.pkl")):
+            out[entry] = path
+    return out
+
+
 class ModelCollection:
     """name -> (model, metadata) for every artifact under ``root``.
 
@@ -123,11 +181,27 @@ class ModelCollection:
     changed artifacts (by ``model.pkl`` mtime) and drops removed ones, so
     a running server can pick up freshly built fleet artifacts without a
     restart (the reference redeployed a pod per model instead).
+
+    ``owned`` (multi-host serving mesh): an explicit member-ownership
+    set — the collection loads and serves ONLY these names even when the
+    artifact dir holds the whole fleet (a shared volume is the common
+    deploy). ``None`` (the default) means unpartitioned: own everything
+    on disk, exactly the old behavior. An owned-but-empty partition is
+    legal (a small fleet over many replicas, or a source replica that
+    migrated everything away) and does NOT raise at startup the way an
+    empty unpartitioned dir does — the mesh routing plane, not this
+    process, decides whether zero members here is a problem.
     """
 
-    def __init__(self, root: str, target_name: Optional[str] = None):
+    def __init__(
+        self,
+        root: str,
+        target_name: Optional[str] = None,
+        owned=None,
+    ):
         self.root = root
         self.target_name = target_name
+        self.owned = None if owned is None else set(owned)
         # (models, metadata) published together as ONE tuple: refresh()
         # builds fresh dicts off to the side and swaps them in with a
         # single (GIL-atomic) assignment, so readers on other threads
@@ -145,7 +219,7 @@ class ModelCollection:
         self.load_failures: Dict[str, str] = {}
         self.load_failed_total: int = 0
         changes = self.refresh()
-        if not self.models:
+        if not self.models and self.owned is None:
             detail = (
                 f"; all artifact loads failed: {changes['failed']}"
                 if changes["failed"]
@@ -182,20 +256,53 @@ class ModelCollection:
         return models[name], metadata.get(name, {})
 
     def _scan(self) -> Dict[str, str]:
-        """name -> artifact dir for the current on-disk state."""
-        if os.path.exists(os.path.join(self.root, "model.pkl")):
-            name = self.target_name or os.path.basename(os.path.normpath(self.root))
-            return {name: self.root}
-        out = {}
-        try:
-            entries = sorted(os.listdir(self.root))
-        except FileNotFoundError:
-            return {}
-        for entry in entries:
-            path = os.path.join(self.root, entry)
-            if os.path.isdir(path) and os.path.exists(os.path.join(path, "model.pkl")):
-                out[entry] = path
-        return out
+        """name -> artifact dir for the current on-disk state, filtered
+        to this collection's ownership set when one is active (the mesh
+        partition: the shared volume holds everyone's artifacts, this
+        replica loads only its own)."""
+        on_disk = scan_artifacts(self.root, self.target_name)
+        if self.owned is None:
+            return on_disk
+        return {n: p for n, p in on_disk.items() if n in self.owned}
+
+    # ------------------------------------------------------------------ #
+    # mesh ownership (multi-host serving): acquire/release move a member
+    # between replicas; the bank rebuild + zero-downtime swap happens in
+    # the caller (server/views.py mesh endpoints, under the reload lock)
+    # ------------------------------------------------------------------ #
+
+    def acquire(self, name: str) -> Dict[str, Any]:
+        """Take ownership of ``name`` (its artifact must already be under
+        ``root`` — the mesh acquire endpoint ships it first) and load it.
+        Idempotent; on an unpartitioned collection ownership is implicit
+        and this is just a refresh. Raises ``FileNotFoundError`` when the
+        artifact is not on disk — taking ownership of nothing would
+        blackhole the member's traffic behind a routing entry."""
+        if self.owned is not None:
+            self.owned.add(name)
+        changes = self.refresh()
+        if name not in self.models:
+            if self.owned is not None:
+                self.owned.discard(name)
+            reason = changes["failed"].get(name, "artifact not found on disk")
+            raise FileNotFoundError(
+                f"cannot acquire {name!r} under {self.root!r}: {reason}"
+            )
+        return changes
+
+    def release(self, name: str) -> Dict[str, Any]:
+        """Drop ownership of ``name`` (the migration source's half of a
+        cross-replica move): the member stops loading/serving here; its
+        artifact stays on disk (cheap, and a failed migration can
+        re-acquire without re-shipping). On an unpartitioned collection
+        the current roster is materialized as the ownership set first —
+        release must work on a replica that booted owning everything."""
+        if name not in self.models:
+            raise KeyError(f"cannot release unknown member {name!r}")
+        if self.owned is None:
+            self.owned = set(self.models)
+        self.owned.discard(name)
+        return self.refresh()
 
     def refresh(self) -> Dict[str, Any]:
         """Incremental rescan. Returns {"added": [...], "updated": [...],

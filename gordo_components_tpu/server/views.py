@@ -19,6 +19,7 @@ import functools
 import json
 import logging
 import math
+import os
 import time
 from typing import Any, Optional
 
@@ -295,8 +296,13 @@ async def readiness(request: web.Request) -> web.Response:
     503 until the collection has loaded at least one model (matching
     the probe's previous effective gate on ``/models``)."""
     n = len(_collection(request).models)
-    body = {"ready": n > 0, "models": n}
-    return web.json_response(body, status=200 if n > 0 else 503)
+    # a mesh replica with an EMPTY partition is ready: it owns nothing
+    # right now (small fleet, or everything migrated away) but is a
+    # healthy acquire target — 503ing it would get it restarted by the
+    # probe exactly when the placement plane wants to hand it members
+    ok = n > 0 or request.app.get("mesh") is not None
+    body = {"ready": ok, "models": n}
+    return web.json_response(body, status=200 if ok else 503)
 
 
 def _healthz_body(app: web.Application) -> tuple:
@@ -315,7 +321,7 @@ def _healthz_body(app: web.Application) -> tuple:
     load_failures = dict(collection.load_failures) if collection is not None else {}
     quarantined = quarantine.snapshot()["quarantined"] if quarantine is not None else {}
     finalize_failures = dict(getattr(bank, "finalize_failures", None) or {})
-    if models == 0:
+    if models == 0 and app.get("mesh") is None:
         status, http = "unhealthy", 503
     elif quarantined or load_failures or finalize_failures:
         status, http = "degraded", 200
@@ -650,6 +656,52 @@ async def metadata_all(request: web.Request) -> web.Response:
     return resp
 
 
+async def _swap_collection_bank(app: web.Application, loop) -> tuple:
+    """Rebuild the HBM bank from the collection's CURRENT models and land
+    it through the zero-downtime swap primitive (placement/swap.py): the
+    replacement builds + warm-compiles off to the side (same mesh/
+    registry/pipeline/precision config and goodput ledger the app booted
+    with, so counters stay monotonic and tuning never silently resets),
+    then one generation flip moves serving over — in-flight batches
+    drain on the old bank, so there is no 5xx window. Shared by /reload
+    and the mesh acquire/release endpoints (one swap discipline, not
+    three). Caller MUST hold the reload lock. Returns
+    ``(bank_models, swap_info)`` — ``(None, None)`` when the bank is
+    disabled."""
+    if not app.get("bank_enabled"):
+        return None, None
+    from gordo_components_tpu.placement.swap import (
+        _restore_collectors,
+        build_bank,
+        snapshot_collectors,
+        swap_bank,
+    )
+
+    collection = app["collection"]
+    prev_collectors = snapshot_collectors(app.get("metrics"))
+    try:
+        bank = await loop.run_in_executor(
+            None, functools.partial(build_bank, app, collection.models)
+        )
+    except Exception:
+        # a stillborn build must not leave the registry pointing at its
+        # dead collectors — the serving bank's series keep rendering
+        # (swap_bank handles the flip-failure case itself)
+        _restore_collectors(app.get("metrics"), prev_collectors)
+        raise
+    result = swap_bank(app, bank, prev_collectors=prev_collectors)
+    controller = app.get("placement")
+    if controller is not None:
+        # every swap path shares the controller's stats/pause histogram:
+        # the generation GET /placement reports must agree with whoever
+        # bumped it (reload, rebalance, or a mesh ownership change)
+        controller.record_swap(result)
+    return result.bank_models, {
+        "generation": result.generation,
+        "pause_ms": round(result.pause_s * 1e3, 3),
+    }
+
+
 @routes.post("/gordo/v0/{project}/reload")
 async def reload_models(request: web.Request) -> web.Response:
     """Rescan the artifact dir and serve new/updated models without a
@@ -673,49 +725,7 @@ async def reload_models(request: web.Request) -> web.Response:
             # quarantine verdict belonged to the OLD bytes
             for name in changes["updated"] + changes["removed"]:
                 quarantine.drop(name)
-        bank_models = None
-        swap_info = None
-        if app.get("bank_enabled"):
-            # the zero-downtime swap primitive (placement/swap.py): the
-            # replacement bank builds and warm-compiles off to the side
-            # (same mesh/registry/pipeline/precision config and goodput
-            # ledger the app booted with, so counters stay monotonic and
-            # tuning never silently resets), then one generation flip
-            # moves serving over — in-flight batches drain on the old
-            # bank, so a reload has no 5xx window
-            from gordo_components_tpu.placement.swap import (
-                build_bank,
-                snapshot_collectors,
-                swap_bank,
-            )
-
-            prev_collectors = snapshot_collectors(app.get("metrics"))
-            try:
-                bank = await loop.run_in_executor(
-                    None,
-                    functools.partial(build_bank, app, collection.models),
-                )
-            except Exception:
-                # a stillborn build must not leave the registry pointing
-                # at its dead collectors — the serving bank's series keep
-                # rendering (swap_bank handles the flip-failure case)
-                from gordo_components_tpu.placement.swap import (
-                    _restore_collectors,
-                )
-
-                _restore_collectors(app.get("metrics"), prev_collectors)
-                raise
-            result = swap_bank(app, bank, prev_collectors=prev_collectors)
-            bank_models = result.bank_models
-            swap_info = {
-                "generation": result.generation,
-                "pause_ms": round(result.pause_s * 1e3, 3),
-            }
-            controller = app.get("placement")
-            if controller is not None:
-                # a reload IS a swap: the controller's stats and pause
-                # histogram must agree with the generation it reports
-                controller.record_swap(result)
+        bank_models, swap_info = await _swap_collection_bank(app, loop)
     body = {
         "changes": changes,
         "models": collection.names(),
@@ -788,6 +798,321 @@ async def rebalance(request: web.Request) -> web.Response:
             status=500,
         )
     return web.json_response(result)
+
+
+# ---------------------------------------------------------------------- #
+# multi-host serving mesh (parallel/distributed.py + watchman routing):
+# ownership introspection, artifact shipping, and the acquire/release
+# halves of a cross-replica member migration. Every ownership change
+# lands through the SAME zero-downtime swap /reload uses, so a migration
+# has no 5xx window on either side.
+# ---------------------------------------------------------------------- #
+
+
+@routes.get("/gordo/v0/{project}/mesh")
+async def mesh_view(request: web.Request) -> web.Response:
+    """This replica's mesh identity + live ownership: which members it
+    serves right now (the boot partition plus/minus any acquire/release
+    since). Watchman's routing table is built from exactly this truth
+    (via ``/models`` — same collection), so the view exists for
+    operators and tests to see the partition without joining metrics."""
+    identity = request.app.get("mesh")
+    collection = _collection(request)
+    body: Any = {
+        "enabled": identity is not None,
+        "owned": collection.names(),
+        "generation": int(request.app.get("bank_generation", 0)),
+    }
+    if identity is not None:
+        body.update(
+            {
+                "replica_id": identity.replica_id,
+                "replica_count": identity.replica_count,
+                "distributed": identity.distributed,
+                "coordinator": identity.coordinator,
+            }
+        )
+    return web.json_response(body)
+
+
+def _member_artifact_dir(request: web.Request, target: str) -> str:
+    """The on-disk artifact dir for an OWNED member, or 404 with the
+    reason (never a bare 404: a migration driver must be able to tell
+    "wrong replica" from "typo'd member")."""
+    from gordo_components_tpu.server.model_io import scan_artifacts
+
+    collection = _collection(request)
+    if target not in collection:
+        raise web.HTTPNotFound(
+            text=json.dumps(
+                {
+                    "error": f"member {target!r} is not owned by this replica",
+                    "owned": len(collection.models),
+                }
+            ),
+            content_type="application/json",
+        )
+    path = scan_artifacts(collection.root, collection.target_name).get(target)
+    if path is None:  # owned in memory but artifact vanished from disk
+        raise web.HTTPNotFound(
+            text=json.dumps(
+                {"error": f"member {target!r} has no artifact dir on disk"}
+            ),
+            content_type="application/json",
+        )
+    return path
+
+
+@routes.get("/gordo/v0/{project}/mesh/member/{target}/artifact")
+async def mesh_member_artifact(request: web.Request) -> web.Response:
+    """The member's artifact dir as a gzipped tar — the shipping half of
+    a cross-replica migration (the acquiring replica pulls this, lands
+    it under its own root, then loads + swaps). Packed on an executor
+    thread: tar+gzip of a model artifact must not stall the event loop
+    that is serving scoring traffic."""
+    target = request.match_info["target"]
+    path = _member_artifact_dir(request, target)
+    from gordo_components_tpu.server.model_io import pack_artifact_dir
+
+    data = await asyncio.get_running_loop().run_in_executor(
+        None, pack_artifact_dir, path
+    )
+    return web.Response(
+        body=data,
+        content_type="application/gzip",
+        headers={"X-Gordo-Member": target},
+    )
+
+
+async def _mesh_body(request: web.Request) -> dict:
+    """The JSON object body every mesh mutation takes (400 otherwise).
+
+    The member name is validated as a bare directory name: acquire joins
+    it into the artifact root and unpacks a network-supplied archive
+    there, so separators, ``..``, or an absolute path would let a
+    hostile caller aim the write outside the root entirely (the archive
+    guards in ``unpack_artifact_dir`` protect paths INSIDE the archive,
+    not the destination)."""
+    try:
+        body = await request.json()
+    except Exception:
+        body = None
+    member = (body or {}).get("member") if isinstance(body, dict) else None
+    if (
+        not isinstance(member, str)
+        or not member
+        or member != os.path.basename(member)
+        or member in (".", "..")
+        or os.path.isabs(member)
+    ):
+        raise web.HTTPBadRequest(
+            text=json.dumps(
+                {
+                    "error": 'expected a JSON body {"member": "<name>", ...} '
+                             "with a plain member name (no path separators)"
+                }
+            ),
+            content_type="application/json",
+        )
+    return body
+
+
+@routes.post("/gordo/v0/{project}/mesh/acquire")
+async def mesh_acquire(request: web.Request) -> web.Response:
+    """Take ownership of a member. Body: ``{"member": name}`` (artifact
+    already on this replica's disk — the shared-volume deploy, and the
+    replica-loss recovery path) or ``{"member": name, "source": url}``
+    (pull the artifact from the source replica's ``.../artifact``
+    endpoint first — the cross-host shipping path).
+
+    Ordering contract (watchman's migration sequence): acquire runs
+    BEFORE the source's release, so mid-migration the member is owned by
+    BOTH replicas and either answers — the zero-non-200 window. The new
+    bank generation lands through the same zero-downtime swap as
+    ``/reload``. Idempotent: acquiring an already-owned member is a
+    no-op 200 (a retried migration step must not rebuild the bank)."""
+    app = request.app
+    body = await _mesh_body(request)
+    member = body["member"]
+    source = body.get("source")
+    if source is not None and not isinstance(source, str):
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "source must be a URL string"}),
+            content_type="application/json",
+        )
+    collection = _collection(request)
+    loop = asyncio.get_running_loop()
+    lock = get_reload_lock(app)
+    async with lock:
+        if member in collection:
+            return web.json_response(
+                {
+                    "acquired": False,
+                    "already_owned": True,
+                    "member": member,
+                    "generation": int(app.get("bank_generation", 0)),
+                }
+            )
+        if source:
+            # pull the artifact from the losing replica (bounded: a hung
+            # source must not pin this replica's reload lock forever)
+            import aiohttp as _aiohttp
+
+            from gordo_components_tpu.resilience.deadline import Deadline
+            from gordo_components_tpu.server.model_io import unpack_artifact_dir
+
+            url = (
+                f"{source.rstrip('/')}/gordo/v0/"
+                f"{request.match_info['project']}/mesh/member/{member}/artifact"
+            )
+
+            async def fetch():
+                async with _aiohttp.ClientSession() as session:
+                    async with session.get(url) as resp:
+                        if resp.status != 200:
+                            raise ValueError(
+                                f"source replied {resp.status}: "
+                                f"{(await resp.text())[:300]}"
+                            )
+                        return await resp.read()
+
+            try:
+                raw = await Deadline(120.0).wait_for(fetch())
+                await loop.run_in_executor(
+                    None,
+                    unpack_artifact_dir,
+                    raw,
+                    os.path.join(collection.root, member),
+                )
+            except Exception as exc:
+                return web.json_response(
+                    {
+                        "acquired": False,
+                        "member": member,
+                        "error": f"artifact fetch from {source} failed: "
+                                 f"{type(exc).__name__}: {exc}",
+                    },
+                    status=502,
+                )
+        try:
+            changes = await loop.run_in_executor(
+                None, collection.acquire, member
+            )
+        except FileNotFoundError as exc:
+            raise web.HTTPNotFound(
+                text=json.dumps(
+                    {
+                        "error": str(exc),
+                        "hint": 'pass {"source": "<replica base url>"} to '
+                                "ship the artifact first",
+                    }
+                ),
+                content_type="application/json",
+            )
+        quarantine = app.get("quarantine")
+        if quarantine is not None:
+            # freshly shipped bytes get a clean breaker slate
+            quarantine.drop(member)
+        try:
+            bank_models, swap_info = await _swap_collection_bank(app, loop)
+        except Exception as exc:
+            # roll ownership back: serving a member the bank rebuild
+            # rejected would route its traffic into per-model fallbacks
+            # nobody planned for — the old generation keeps serving and
+            # the migration driver sees a clean failure to retry
+            await loop.run_in_executor(None, collection.release, member)
+            logger.exception("mesh acquire of %r failed at bank swap", member)
+            return web.json_response(
+                {
+                    "acquired": False,
+                    "member": member,
+                    "rolled_back": True,
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "generation": int(app.get("bank_generation", 0)),
+                },
+                status=500,
+            )
+    return web.json_response(
+        {
+            "acquired": True,
+            "member": member,
+            "shipped": bool(source),
+            "changes": changes,
+            "bank_models": bank_models,
+            "swap": swap_info,
+            "owned": collection.names(),
+        }
+    )
+
+
+@routes.post("/gordo/v0/{project}/mesh/release")
+async def mesh_release(request: web.Request) -> web.Response:
+    """Drop ownership of a member (the source's half of a migration,
+    AFTER the target acquired and the routing table moved). The artifact
+    stays on disk — a failed migration re-acquires locally instead of
+    re-shipping — and the new (smaller) bank generation lands through
+    the zero-downtime swap. 404 with the reason for a member this
+    replica does not own."""
+    app = request.app
+    body = await _mesh_body(request)
+    member = body["member"]
+    collection = _collection(request)
+    loop = asyncio.get_running_loop()
+    lock = get_reload_lock(app)
+    async with lock:
+        try:
+            changes = await loop.run_in_executor(
+                None, collection.release, member
+            )
+        except KeyError as exc:
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": str(exc.args[0])}),
+                content_type="application/json",
+            )
+        quarantine = app.get("quarantine")
+        if quarantine is not None:
+            quarantine.drop(member)
+        try:
+            bank_models, swap_info = await _swap_collection_bank(app, loop)
+        except Exception as exc:
+            # re-acquire locally (the artifact is still on disk): a
+            # failed rebuild must not leave the member unowned ANYWHERE
+            # while the routing table still points here. Off the event
+            # loop (it re-loads the artifact), and guarded: if the
+            # re-acquire ALSO fails (artifact corrupt — likely the same
+            # root cause) the 500 must still answer, flagged so the
+            # migration driver knows the member truly has no owner here
+            reacquired = True
+            try:
+                await loop.run_in_executor(None, collection.acquire, member)
+            except Exception:
+                reacquired = False
+                logger.exception(
+                    "mesh release rollback could not re-acquire %r; the "
+                    "member is NOT served by this replica", member,
+                )
+            logger.exception("mesh release of %r failed at bank swap", member)
+            return web.json_response(
+                {
+                    "released": False,
+                    "member": member,
+                    "rolled_back": reacquired,
+                    "reacquire_failed": not reacquired,
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "generation": int(app.get("bank_generation", 0)),
+                },
+                status=500,
+            )
+    return web.json_response(
+        {
+            "released": True,
+            "member": member,
+            "changes": changes,
+            "bank_models": bank_models,
+            "swap": swap_info,
+            "owned": collection.names(),
+        }
+    )
 
 
 def _stream_plane(request: web.Request):

@@ -18,6 +18,7 @@ the collection doesn't know). Watchman discovers targets from ``GET
 import asyncio
 import logging
 import math
+import zlib
 from typing import Any, Dict, List, Optional
 
 import aiohttp
@@ -151,7 +152,9 @@ def aggregate_fleet_metrics(
 
 
 def render_fleet_metrics(
-    agg: Dict[str, Any], now_mono: Optional[float] = None
+    agg: Dict[str, Any],
+    now_mono: Optional[float] = None,
+    extra_gauges: Optional[List[tuple]] = None,
 ) -> str:
     """Aggregated rollup as Prometheus text: computed fleet gauges first,
     then the scraped series under their original names (federation-style,
@@ -204,6 +207,12 @@ def render_fleet_metrics(
             ("gordo_fleet_shard_skew_ratio", {}, float(agg["shard_skew_ratio"]))
         )
         types["gordo_fleet_shard_skew_ratio"] = "gauge"
+    # routing-plane gauges (multi-host serving): rendered only when the
+    # caller passes them (a watchman that never built a table emits none)
+    for name, mtype, help_text, labels, value in extra_gauges or ():
+        samples.append((name, labels, float(value)))
+        types[name] = mtype
+        helps[name] = help_text
     shard_rows = agg["routed_rows_by_shard"]
     if shard_rows:
         vals = list(shard_rows.values())
@@ -291,6 +300,35 @@ class WatchmanState:
         self._drift_time = 0.0
         self._drift_lock = asyncio.Lock()
         self._drift_task: Optional[asyncio.Task] = None
+        # --- routing/membership plane (multi-host serving mesh) ---
+        # versioned member -> replica table, built from each replica's
+        # /models (its live ownership truth) + /healthz; the version
+        # bumps ONLY when table content changes, so clients cache on the
+        # ETag and a rebalance is detectable as a version step
+        self._routing_cache: Optional[Dict[str, Any]] = None
+        self._routing_time = 0.0
+        self._routing_version = 0
+        self._routing_core: Optional[Any] = None  # comparable content key
+        self._routing_lock = asyncio.Lock()
+        self._routing_task: Optional[asyncio.Task] = None
+        # migration pins: member -> destination replica, set the moment a
+        # move's acquire lands so routing flips BEFORE the source
+        # releases (the zero-404 ordering); dropped once observation
+        # confirms single ownership at the destination
+        self._routing_overrides: Dict[str, int] = {}
+        # per-replica full member lists from the last routing refresh
+        # (fleet-planner input; deliberately NOT in the GET /routing body
+        # — the members map already carries the full assignment once)
+        self._routing_member_lists: Dict[int, List[str]] = {}
+        self._migrations_total = 0
+        self._migrations_failed = 0
+        # moves serialize: two concurrent migrations of one member (or
+        # interleaved acquire/release on one replica) is how routing
+        # truth forks
+        self._migration_lock = asyncio.Lock()
+        self.mesh_min_rows = int(
+            env_num("GORDO_MESH_MIN_ROWS", 1024.0, float)
+        )
 
     def _url(self, target: str, endpoint: str) -> str:
         return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
@@ -707,6 +745,439 @@ class WatchmanState:
                 out.append(base)
         return out
 
+    # ------------------------------------------------------------------ #
+    # routing/membership plane (multi-host serving mesh)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    async def _get_json(session, url: str, deadline: float = 10.0):
+        """Bounded best-effort JSON GET for the routing plane: None on
+        any failure (an unreachable replica is a table entry, never an
+        exception). Non-2xx bodies that still parse are RETURNED — a
+        503 /healthz body carries the status we need."""
+
+        async def get():
+            async with session.get(url) as resp:
+                try:
+                    return await resp.json()
+                except Exception:
+                    return None
+
+        try:
+            return await Deadline(deadline).wait_for(get())
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            logger.debug("routing fetch failed for %s: %s", url, exc)
+            return None
+
+    async def routing(
+        self, refresh: bool = False, wait: bool = True
+    ) -> Optional[Dict[str, Any]]:
+        """The versioned routing table: member -> owning replica, plus
+        per-replica health the client's hedging consults. Built by
+        fetching every replica's ``/models`` (live ownership truth: the
+        collection behind it is exactly what answers scoring requests)
+        and ``/healthz`` (ok/degraded/unhealthy + the quarantined set).
+
+        Versioning rule: the version bumps IFF the table's content
+        (ownership, reachability, health status, quarantine sets)
+        changed since the last build — a quiet fleet re-observed keeps
+        its version, so ``ETag``-conditional polls are free. Members
+        observed on several replicas mid-migration resolve to the
+        pinned override (the move's destination) when one is active,
+        else the lowest replica index, and are listed under
+        ``migrating`` so operators can watch the overlap window close.
+
+        ``wait=False`` (the health-snapshot path) serves the cache and
+        kicks a background refresh — the ``/`` endpoint never inherits
+        a dead replica's fetch timeout."""
+        if not wait:
+            if (
+                self._routing_cache is None
+                or self.clock.monotonic() - self._routing_time
+                >= self.refresh_interval
+            ) and (self._routing_task is None or self._routing_task.done()):
+                self._routing_task = asyncio.get_running_loop().create_task(
+                    self.routing()
+                )
+            return self._stamped_routing()
+        async with self._routing_lock:
+            now = self.clock.monotonic()
+            if (
+                not refresh
+                and self._routing_cache is not None
+                and now - self._routing_time < self.refresh_interval
+            ):
+                return self._stamped_routing()
+            prefixes = self._replica_prefixes()
+            # base per PREFIX, not via replica_base_urls(): that list is
+            # deduplicated, so two scrape targets sharing a host would
+            # shift every later replica's index and stamp replica i with
+            # replica j's url/health
+            marker = "/gordo/v0/"
+            bases = [
+                p.split(marker, 1)[0] if marker in p else p for p in prefixes
+            ]
+            timeout = aiohttp.ClientTimeout(total=30)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+
+                async def probe(i: int, prefix: str):
+                    models, health = await asyncio.gather(
+                        self._get_json(session, prefix + "/models"),
+                        self._get_json(session, prefix + "/healthz"),
+                    )
+                    return i, models, health
+
+                results = await asyncio.gather(
+                    *(probe(i, p) for i, p in enumerate(prefixes))
+                )
+            replicas: List[Dict[str, Any]] = []
+            observed: Dict[str, List[int]] = {}
+            member_lists: Dict[int, List[str]] = {}
+            for i, models_body, health_body in results:
+                base = bases[i]
+                names = []
+                reachable = False
+                if isinstance(models_body, dict) and isinstance(
+                    models_body.get("models"), list
+                ):
+                    reachable = True
+                    names = [str(n) for n in models_body["models"]]
+                status = "unreachable"
+                quarantined: List[str] = []
+                if isinstance(health_body, dict) and health_body.get("status"):
+                    status = str(health_body["status"])
+                    quarantined = sorted(health_body.get("quarantined") or {})
+                elif reachable:
+                    # /models answered but /healthz didn't (foreign
+                    # server): servable, health unknown
+                    status = "ok"
+                member_lists[i] = names
+                for name in names:
+                    observed.setdefault(name, []).append(i)
+                replicas.append(
+                    {
+                        "replica": i,
+                        "url": base,
+                        "reachable": reachable,
+                        "status": status,
+                        "models": len(names),
+                        "quarantined": quarantined,
+                    }
+                )
+            members: Dict[str, int] = {}
+            migrating: Dict[str, List[int]] = {}
+            for name, owners in observed.items():
+                override = self._routing_overrides.get(name)
+                if override is not None and override in owners:
+                    members[name] = override
+                    if len(owners) == 1:
+                        # migration converged at the destination: unpin
+                        del self._routing_overrides[name]
+                else:
+                    if override is not None:
+                        # destination lost (or never gained) the member:
+                        # observation wins, the pin is void
+                        del self._routing_overrides[name]
+                    # multi-owned with no pin (a fully REPLICATED fleet,
+                    # or a dual-owner overlap nobody is driving): spread
+                    # primaries deterministically by name hash — "lowest
+                    # index wins" would route every member of a
+                    # replicated fleet to replica 0 and idle the rest
+                    owners_sorted = sorted(owners)
+                    members[name] = owners_sorted[
+                        zlib.crc32(name.encode()) % len(owners_sorted)
+                    ]
+                if len(owners) > 1:
+                    migrating[name] = sorted(owners)
+            # drop pins for members that vanished entirely
+            for name in list(self._routing_overrides):
+                if name not in observed:
+                    del self._routing_overrides[name]
+            core = self._routing_content_key(members, replicas, migrating)
+            if core != self._routing_core:
+                self._routing_version += 1
+                self._routing_core = core
+            self._routing_member_lists = member_lists
+            self._routing_cache = {
+                "project": self.project,
+                "version": self._routing_version,
+                "members": members,
+                "migrating": migrating,
+                "replicas": replicas,
+                "refresh_interval": self.refresh_interval,
+            }
+            self._routing_time = self.clock.monotonic()
+            return self._stamped_routing()
+
+    @staticmethod
+    def _routing_content_key(members, replicas, migrating) -> tuple:
+        """The comparable content of a routing table: the version bumps
+        IFF this changes (the ETag contract's definition of 'changed')."""
+        return (
+            tuple(sorted(members.items())),
+            tuple(
+                (r["replica"], r["url"], r["reachable"], r["status"],
+                 tuple(r["quarantined"]))
+                for r in replicas
+            ),
+            tuple(sorted((k, tuple(v)) for k, v in migrating.items())),
+        )
+
+    def _stamped_routing(self) -> Optional[Dict[str, Any]]:
+        """The cached table with a LIVE age stamp — staleness must keep
+        aging between refreshes, so a client can tell 'fresh table' from
+        'watchman stopped observing' without comparing clocks."""
+        if self._routing_cache is None:
+            return None
+        age = max(0.0, self.clock.monotonic() - self._routing_time)
+        body = dict(self._routing_cache)
+        body["age_s"] = round(age, 3)
+        body["stale"] = age >= 2 * self.refresh_interval
+        return body
+
+    def _bump_routing_owner(self, member: str, dst: int) -> None:
+        """Flip a member's owner in the LIVE table (called between a
+        move's acquire and release): the table must route to the
+        destination before the source stops answering. Bumps the
+        version — this IS a content change."""
+        self._routing_overrides[member] = dst
+        if self._routing_cache is not None:
+            members = dict(self._routing_cache["members"])
+            if members.get(member) != dst:
+                members[member] = dst
+                self._routing_version += 1
+                # recompute the content key from the FLIPPED table: a
+                # clean migration then costs exactly ONE version bump —
+                # the post-release rebuild (same members, overlap closed)
+                # compares equal and keeps the version, so ETag pollers
+                # never refetch a byte-identical table
+                self._routing_core = self._routing_content_key(
+                    members,
+                    self._routing_cache["replicas"],
+                    self._routing_cache["migrating"],
+                )
+                self._routing_cache = {
+                    **self._routing_cache,
+                    "members": members,
+                    "version": self._routing_version,
+                }
+
+    async def _replica_health_for_moves(self) -> Dict[int, str]:
+        """Destination-eligibility map for the fleet planner: the routing
+        table's per-replica status, escalated to ``burning`` when the
+        replica's SLO rollup shows a fast burn (PR 7's signal) — a
+        replica paying down an error budget must not be handed MORE
+        members, even if its /healthz still says ok."""
+        table = await self.routing()
+        health: Dict[int, str] = {}
+        for rep in (table or {}).get("replicas", []):
+            health[rep["replica"]] = (
+                rep["status"] if rep["reachable"] else "unreachable"
+            )
+        try:
+            slo = await self.fleet_slo()
+        except Exception:
+            return health
+        for entry in slo.get("replicas", []):
+            worst = entry.get("worst") or {}
+            if isinstance(worst, dict) and worst.get("fast_burn"):
+                health[entry["replica"]] = "burning"
+        return health
+
+    async def fleet_loads(self) -> Dict[str, float]:
+        """Fleet-rolled per-member routed rows over each replica's
+        decision window: every replica's ``GET /placement``
+        ``member_rows`` summed by member (a member normally lives on one
+        replica; mid-migration both sides' windows count — the member
+        really did route that much). The fleet planner's load signal."""
+        urls = [p + "/placement" for p in self._replica_prefixes()]
+        timeout = aiohttp.ClientTimeout(total=30)
+        loads: Dict[str, float] = {}
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            bodies = await asyncio.gather(
+                *(self._get_json(session, u) for u in urls)
+            )
+        for body in bodies:
+            if not isinstance(body, dict):
+                continue
+            for name, rows in (body.get("member_rows") or {}).items():
+                try:
+                    loads[name] = loads.get(name, 0.0) + float(rows)
+                except (TypeError, ValueError):
+                    continue
+        return loads
+
+    async def apply_move(
+        self,
+        member: str,
+        dst: int,
+        src: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """One cross-replica migration, the zero-404 sequence:
+
+        1. **acquire** on the destination (shipping the artifact from
+           the source's ``.../artifact`` endpoint when the source is
+           reachable; from the destination's own disk otherwise — the
+           replica-loss recovery path);
+        2. **route** — pin the member's owner to the destination and
+           bump the table version, so clients learning the new table go
+           to the replica that now definitely owns it, while clients on
+           the old table still hit the source, which ALSO still owns it;
+        3. **release** on the source (skipped when unreachable).
+
+        Between 1 and 3 the member is dual-owned and both replicas
+        answer identically — the migration has no window in which any
+        correctly-routed request can 404. Serialized with other moves."""
+        async with self._migration_lock:
+            table = await self.routing(refresh=True)
+            if table is None:
+                return {"moved": False, "member": member,
+                        "error": "no routing table (no replicas observed)"}
+            replicas = table["replicas"]
+            if not 0 <= dst < len(replicas):
+                return {"moved": False, "member": member,
+                        "error": f"unknown destination replica {dst}"}
+            if src is None:
+                src = table["members"].get(member)
+            if src == dst:
+                return {"moved": False, "member": member, "src": src,
+                        "dst": dst, "error": "member already at destination"}
+            prefixes = self._replica_prefixes()
+            src_rep = (
+                replicas[src] if src is not None and 0 <= src < len(replicas)
+                else None
+            )
+            src_reachable = bool(src_rep and src_rep["reachable"])
+            payload: Dict[str, Any] = {"member": member}
+            if src_reachable:
+                payload["source"] = src_rep["url"]
+            timeout = aiohttp.ClientTimeout(total=300)
+            verdict: Dict[str, Any] = {
+                "member": member, "src": src, "dst": dst,
+            }
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+
+                async def post(url, body):
+                    async def go():
+                        async with session.post(url, json=body) as resp:
+                            try:
+                                return resp.status, await resp.json()
+                            except Exception:
+                                return resp.status, {}
+
+                    # generous: an acquire pays an artifact ship + bank
+                    # build + warm compile before it answers
+                    return await Deadline(240.0).wait_for(go())
+
+                try:
+                    status, body = await post(
+                        prefixes[dst] + "/mesh/acquire", payload
+                    )
+                except Exception as exc:
+                    self._migrations_failed += 1
+                    verdict.update(
+                        moved=False,
+                        error=f"acquire failed: {type(exc).__name__}: {exc}",
+                    )
+                    return verdict
+                verdict["acquire"] = {
+                    "status": status,
+                    "swap": body.get("swap"),
+                    "already_owned": bool(body.get("already_owned")),
+                }
+                if status != 200:
+                    self._migrations_failed += 1
+                    verdict.update(
+                        moved=False,
+                        error=f"acquire answered {status}: "
+                              f"{body.get('error')}",
+                    )
+                    return verdict
+                # destination owns it: flip routing BEFORE the release
+                self._bump_routing_owner(member, dst)
+                if src is not None and src_reachable:
+                    try:
+                        status, body = await post(
+                            prefixes[src] + "/mesh/release",
+                            {"member": member},
+                        )
+                        verdict["release"] = {
+                            "status": status, "swap": body.get("swap"),
+                        }
+                        if status != 200:
+                            # dual ownership persists — safe (both answer);
+                            # flagged so the operator retries the release
+                            verdict["warning"] = (
+                                f"release answered {status}: "
+                                f"{body.get('error')} (member dual-owned "
+                                "until retried)"
+                            )
+                    except Exception as exc:
+                        verdict["warning"] = (
+                            f"release failed: {type(exc).__name__}: {exc} "
+                            "(member dual-owned until retried)"
+                        )
+                else:
+                    verdict["release"] = {"skipped": "source unreachable"}
+            self._migrations_total += 1
+            await self.routing(refresh=True)
+            verdict.update(moved=True, routing_version=self._routing_version)
+            return verdict
+
+    async def fleet_rebalance_cross(
+        self, dry_run: bool = False, force: bool = False
+    ) -> Dict[str, Any]:
+        """The fleet placement tier end-to-end: observe ownership +
+        fleet-rolled loads, plan cross-replica moves
+        (placement/planner.py::plan_fleet — degraded/burning replicas
+        are never move destinations), and apply them move-by-move
+        through :meth:`apply_move` (each one a zero-404 acquire ->
+        route -> release sequence riding both banks' hot-swaps).
+        ``force`` overrides the improvement threshold and the min-rows
+        floor, never the health gates."""
+        from gordo_components_tpu.placement.planner import plan_fleet
+
+        await self.routing(refresh=True)
+        members_by_replica = dict(self._routing_member_lists)
+        loads, health = await asyncio.gather(
+            self.fleet_loads(), self._replica_health_for_moves()
+        )
+        plan = plan_fleet(
+            members_by_replica,
+            loads,
+            replica_health=health,
+            min_rows=0 if force else self.mesh_min_rows,
+        )
+        applicable = plan.should_apply or (force and bool(plan.moves))
+        if dry_run or not applicable:
+            return {
+                "applied": 0,
+                "dry_run": dry_run,
+                "plan": plan.summary(),
+                "routing_version": self._routing_version,
+            }
+        verdicts = []
+        applied = 0
+        for move in plan.moves:
+            verdict = await self.apply_move(move.member, move.dst, src=move.src)
+            verdicts.append(verdict)
+            if not verdict.get("moved"):
+                # a failed acquire aborts the remainder: the plan was
+                # computed against an ownership state that just refused
+                # to change, and pushing on would compound the drift
+                break
+            applied += 1
+        return {
+            "applied": applied,
+            "dry_run": False,
+            "forced": force and not plan.should_apply,
+            "plan": plan.summary(),
+            "moves": verdicts,
+            "routing_version": self._routing_version,
+        }
+
     async def fleet_slow_traces(self, per_replica: int = 5) -> Dict[str, Any]:
         """Fleet flight-recorder view: each replica's worst recent traces
         (its slow reservoir, slowest first), plus a fleet-wide ``worst``
@@ -977,8 +1448,42 @@ def build_watchman_app(
     async def root(request: web.Request) -> web.Response:
         body = dict(await state.snapshot())  # copy: the cache must stay clean
         # the fleet's replica target list (derived from the metrics
-        # scrape config): hedging clients pick their second replica here
-        body["replicas"] = state.replica_base_urls()
+        # scrape config), stamped with the routing plane's version +
+        # per-replica health/staleness: a hedging or fan-out client can
+        # tell a STALE table (watchman stopped observing, or the version
+        # moved under it after a rebalance) from a fresh one instead of
+        # silently mis-routing. Entries are objects; the bare URL list
+        # the pre-mesh snapshot served lives in each entry's "url"
+        # (Client.replicas_from_watchman accepts both forms).
+        # wait=False: the health path never blocks on a routing rebuild
+        table = await state.routing(wait=False)
+        if table is not None:
+            # the table's own entries: per-replica url/health came from
+            # the same observation, so the stamps can never misalign
+            body["replicas"] = [
+                {
+                    "replica": rep["replica"],
+                    "url": rep["url"],
+                    "routing_version": table["version"],
+                    "routing_age_s": table["age_s"],
+                    "status": rep["status"],
+                    "reachable": rep["reachable"],
+                }
+                for rep in table["replicas"]
+            ]
+        else:  # no observation yet: the configured target list
+            body["replicas"] = [
+                {"replica": i, "url": url}
+                for i, url in enumerate(state.replica_base_urls())
+            ]
+        if table is not None:
+            body["routing"] = {
+                "version": table["version"],
+                "age_s": table["age_s"],
+                "stale": table["stale"],
+                "members": len(table["members"]),
+                "migrating": len(table["migrating"]),
+            }
         # bounded fleet-metrics summary rides along so one snapshot answers
         # both "is the fleet healthy" and "is any shard hot anywhere".
         # wait=False: the health path must not inherit a hung replica's
@@ -1041,9 +1546,36 @@ def build_watchman_app(
         agg = await state.fleet_metrics(wait=state._metrics_cache is None)
         if agg is None:  # lost the first-scrape race: render an empty rollup
             agg = aggregate_fleet_metrics([])
+        extra = []
+        if state._routing_cache is not None or state._migrations_total:
+            # stability contract (docs/observability.md): the routing
+            # plane's version/migration counters, rendered once a table
+            # exists so pre-mesh watchmen emit nothing new
+            extra = [
+                (
+                    "gordo_fleet_routing_version", "gauge",
+                    "Routing-table version (bumps iff table content "
+                    "changed: ownership, health, or migration overlap)",
+                    {}, state._routing_version,
+                ),
+                (
+                    "gordo_fleet_migrations_total", "counter",
+                    "Cross-replica migrations whose ownership flipped "
+                    "(destination acquired + routing repointed); a failed "
+                    "release leaves the member dual-owned — visible in "
+                    "the routing table's `migrating` map, not here", {},
+                    state._migrations_total,
+                ),
+                (
+                    "gordo_fleet_migrations_failed_total", "counter",
+                    "Cross-replica migrations that failed at the acquire "
+                    "step (ownership unchanged)", {},
+                    state._migrations_failed,
+                ),
+            ]
         return web.Response(
             body=render_fleet_metrics(
-                agg, now_mono=state.clock.monotonic()
+                agg, now_mono=state.clock.monotonic(), extra_gauges=extra
             ).encode("utf-8"),
             headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
         )
@@ -1087,6 +1619,84 @@ def build_watchman_app(
         rollup = await state.fleet_drift(refresh=refresh)
         return web.json_response(rollup)
 
+    async def routing_view(request: web.Request) -> web.Response:
+        """The versioned routing table (multi-host serving): member ->
+        owning replica + per-replica health. ``ETag``-conditional: pass
+        ``If-None-Match`` with the last seen tag and an unchanged table
+        answers 304 with no body — the cheap poll loop the fan-out
+        client runs. ``?refresh=1`` forces a fresh observation."""
+        refresh = request.query.get("refresh", "").lower() in (
+            "1", "true", "yes",
+        )
+        table = await state.routing(refresh=refresh)
+        if table is None:
+            # no replicas observable yet: an EMPTY fleet is a valid
+            # (version-0) table, not an error — clients fall back to
+            # their configured base URL
+            table = {
+                "project": state.project, "version": 0, "members": {},
+                "migrating": {}, "replicas": [], "age_s": None,
+                "stale": True,
+                "refresh_interval": state.refresh_interval,
+            }
+        etag = f'"routing-v{table["version"]}"'
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers={"ETag": etag})
+        return web.json_response(table, headers={"ETag": etag})
+
+    async def migrate(request: web.Request) -> web.Response:
+        """Operator-driven single-member migration: JSON body
+        ``{"member": name, "to": replica_index}`` (optional ``"from"``)
+        runs the zero-404 acquire -> route -> release sequence. The
+        programmatic form of what ``POST /fleet-rebalance`` does per
+        planned move."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = None
+        if (
+            not isinstance(body, dict)
+            or not isinstance(body.get("member"), str)
+            or not isinstance(body.get("to"), int)
+        ):
+            raise web.HTTPBadRequest(
+                text='{"error": "expected {\\"member\\": \\"<name>\\", '
+                     '\\"to\\": <replica index>}"}',
+                content_type="application/json",
+            )
+        src = body.get("from")
+        if src is not None and not isinstance(src, int):
+            raise web.HTTPBadRequest(
+                text='{"error": "from must be a replica index"}',
+                content_type="application/json",
+            )
+        verdict = await state.apply_move(body["member"], body["to"], src=src)
+        return web.json_response(
+            verdict, status=200 if verdict.get("moved") else 409
+        )
+
+    async def fleet_rebalance_cross(request: web.Request) -> web.Response:
+        """The fleet placement tier: plan cross-replica ownership moves
+        from fleet-rolled routing counters (``?dry_run=1`` previews) and
+        apply them through the migration sequence. ``{"force": true}``
+        overrides the improvement/min-rows gates — never the health
+        gates (a degraded, unreachable, or SLO-burning replica is not a
+        valid destination under any flag)."""
+        dry_run = request.query.get("dry_run", "").lower() in (
+            "1", "true", "yes",
+        )
+        force = False
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                body = None
+            if isinstance(body, dict):
+                force = bool(body.get("force", False))
+        return web.json_response(
+            await state.fleet_rebalance_cross(dry_run=dry_run, force=force)
+        )
+
     async def rebalance(request: web.Request) -> web.Response:
         """Fleet rebalance fan-out: forward ``POST /rebalance`` to every
         replica (``?dry_run=1`` previews; JSON body ``{"force": true}``
@@ -1113,6 +1723,9 @@ def build_watchman_app(
     app.router.add_get("/slo", slo)
     app.router.add_get("/drift", drift)
     app.router.add_post("/rebalance", rebalance)
+    app.router.add_get("/routing", routing_view)
+    app.router.add_post("/migrate", migrate)
+    app.router.add_post("/fleet-rebalance", fleet_rebalance_cross)
     return app
 
 
