@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -98,6 +98,17 @@ saturate:
 mesh:
 	$(PYTHON) -m pytest tests/ -q -m mesh --continue-on-collection-errors
 
+# fleet lane: the declarative fleet compiler — compile-only golden-DAG
+# determinism (YAML in -> byte-identical DAG JSON out), content-digest
+# incremental staleness, spec/canary validation, the canary judge's
+# verdict edges (zero-traffic hold, burn/goodput rollback), and the
+# live-server execution legs: end-to-end build -> place -> canary ->
+# promote with zero data-plane non-200s, SLO fast-burn auto-rollback,
+# the workflow.canary chaos rollback, and incremental re-run asserted
+# by step-key digests (tests/test_fleet_compiler.py)
+fleet:
+	$(PYTHON) -m pytest tests/ -q -m fleet --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -171,6 +182,14 @@ replay-demo:
 # bench.py's `mesh_serving` leg runs the same tool)
 mesh-demo:
 	$(PYTHON) tools/mesh_demo.py
+
+# compiles a fleet spec to the typed DAG, executes it end to end against
+# a live in-process server (build gangs -> place -> canary -> promote
+# under scoring traffic), then edits one machine and re-runs to show the
+# incremental recompile ratio; prints one JSON doc (tools/fleet_demo.py;
+# bench.py's `fleet_compile` leg runs the compile-side measurements)
+fleet-demo:
+	$(PYTHON) tools/fleet_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -885,6 +885,107 @@ def bench_replay(epochs=3, speed=500.0):
     }
 
 
+def bench_fleet_compile(members_compile=2048, demo_members=8):
+    """Declarative fleet compiler (ISSUE 15) — two measurements:
+
+    (a) compile-side scale, in-process: one ``members_compile``-machine
+    spec compiled to the typed build/place/canary/promote DAG (wall
+    time, step counts, DAG JSON size), then ONE machine edited and the
+    stale subgraph computed against the first DAG's content-digest keys
+    — the incremental-recompile ratio a 100k-member fleet's edit loop
+    rides on (cached fraction; higher is better, bounded by the rollout
+    tail that must always re-run).
+
+    (b) the full rollout loop end to end via tools/fleet_demo.py in a
+    subprocess (env knobs land before server import): compile -> gang
+    build -> live canary under traffic -> promote -> incremental re-run
+    -> injected fast-burn auto-rollback, with the zero-non-200 and
+    rollback verdicts asserted."""
+    import time as _time
+
+    from gordo_components_tpu.workflow import compile_fleet
+
+    def synth_spec(n, rev=1):
+        machines = []
+        for i in range(n):
+            tags = [f"t{i}-{j}" for j in range(3 + (i % 4))]  # 4 buckets
+            machines.append(
+                {
+                    "name": f"fc-{i}",
+                    "dataset": {
+                        "type": "RandomDataset",
+                        "tag_list": tags,
+                        "train_start_date": "2020-01-01T00:00:00Z",
+                        "train_end_date": "2020-01-08T00:00:00Z",
+                    },
+                    "metadata": {"rev": rev if i == 0 else 1},
+                }
+            )
+        return {
+            "machines": machines,
+            "fleet": {"canary": {"window_s": 30}, "schedules": {"refit_every": "6h"}},
+        }
+
+    t0 = _time.time()
+    dag = compile_fleet(synth_spec(members_compile), "bench")
+    compile_s = _time.time() - t0
+    doc = dag.to_json()
+    t0 = _time.time()
+    edited = compile_fleet(synth_spec(members_compile, rev=2), "bench")
+    recompile_s = _time.time() - t0
+    stale = edited.stale_steps(dag.keys())
+    total = len(dag.steps)
+    out = {
+        "fleet_compile_members": members_compile,
+        "fleet_compile_s": round(compile_s, 4),
+        "fleet_recompile_s": round(recompile_s, 4),
+        "fleet_compile_steps": total,
+        "fleet_compile_step_counts": dag.counts(),
+        "fleet_dag_json_bytes": len(doc),
+        "fleet_edit_stale_steps": len(stale),
+        # cached fraction on a one-machine edit: the incremental-recompile
+        # ratio (rollout tail + the edited chain always re-run)
+        "fleet_incremental_ratio": round((total - len(stale)) / total, 6),
+    }
+    assert (
+        compile_fleet(synth_spec(members_compile), "bench").to_json() == doc
+    ), "fleet DAG compile must be deterministic"
+    assert len(stale) <= 5, stale  # build + bucket + place/canary/promote
+
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "fleet_demo.py"
+    )
+    res = subprocess.run(
+        [sys.executable, tool, "--members", str(demo_members), "--platform", "cpu"],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if res.returncode != 0:
+        tail = (res.stderr or res.stdout or "").strip().splitlines()
+        raise RuntimeError(f"fleet demo failed: {' | '.join(tail[-3:])}")
+    lines = res.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    demo = json.loads("\n".join(lines[start:]))
+    assert demo["passed"], demo
+    out.update(
+        {
+            "fleet_demo_members": demo["members"],
+            "fleet_demo_seed_build_s": demo["seed_build_s"],
+            "fleet_demo_rollout_s": demo["rollout"]["wall_s"],
+            "fleet_demo_incremental_rerun_s": demo["incremental"]["wall_s"],
+            "fleet_demo_incremental_ratio": demo["incremental"][
+                "incremental_ratio"
+            ],
+            "fleet_demo_non200": (
+                demo["rollout"]["non_200"] + demo["incremental"]["non_200"]
+            ),
+            "fleet_demo_burn_rollback": demo["burn_rollback"]["rolled_back"],
+            "fleet_demo": demo,
+        }
+    )
+    return out
+
+
 def bench_serving_saturation(rows=500, posts=40, workers=2, push_batches=8):
     """Serving-plane saturation (ISSUE 13) — end-to-end rows/s per
     transport (tcp / uds / shm ring) through the real multi-worker pool
@@ -1529,6 +1630,7 @@ METRICS = (
     ("rebalance", bench_rebalance),
     ("streaming", bench_streaming),
     ("replay", bench_replay),
+    ("fleet_compile", bench_fleet_compile),
     ("serving_saturation", bench_serving_saturation),
     ("mesh_serving", bench_mesh_serving),
     ("model_zoo", bench_sequence_models),
@@ -1559,6 +1661,7 @@ CPU_KWARGS = {
     "rebalance": dict(members=64, request_rows=32),
     "streaming": dict(members=4, rows=64, epochs=2),
     "replay": dict(epochs=2),
+    "fleet_compile": dict(members_compile=512, demo_members=6),
     "serving_saturation": dict(rows=300, posts=20, push_batches=5),
     "mesh_serving": dict(models=6, rows=300, posts=10),
     "host_pipeline": dict(n_members=64),

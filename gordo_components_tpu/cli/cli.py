@@ -358,6 +358,77 @@ def workflow_group():
     """Workflow generation."""
 
 
+@workflow_group.command("compile")
+@click.option("--machine-config", "-f", required=True, type=click.Path(exists=True))
+@click.option("--project-name", "-p", required=True)
+@click.option("--output-file", "-o", default=None, type=click.Path())
+@click.option("--models-per-bucket", default=None, type=int)
+@click.option("--devices-per-bucket", default=None, type=int)
+def workflow_compile(machine_config, project_name, output_file,
+                     models_per_bucket, devices_per_bucket):
+    """Compile a fleet spec into the typed build/place/canary/promote
+    DAG (deterministic JSON — the reviewed rollout artifact)."""
+    from gordo_components_tpu.workflow import compile_fleet
+
+    overrides = {}
+    if models_per_bucket:
+        overrides["models_per_bucket"] = models_per_bucket
+    if devices_per_bucket:
+        overrides["devices_per_bucket"] = devices_per_bucket
+    try:
+        with open(machine_config) as f:
+            dag = compile_fleet(yaml.safe_load(f), project_name, **overrides)
+    except (ValueError, yaml.YAMLError) as exc:
+        click.echo(f"Invalid fleet spec: {exc}", err=True)
+        sys.exit(EXIT_CONFIG_ERROR)
+    doc = dag.to_json()
+    if output_file:
+        with open(output_file, "w") as f:
+            f.write(doc + "\n")
+        click.echo(output_file)
+    else:
+        click.echo(doc)
+
+
+@workflow_group.command("run")
+@click.option("--machine-config", "-f", required=True, type=click.Path(exists=True))
+@click.option("--project-name", "-p", required=True)
+@click.option("--state-dir", envvar="GORDO_FLEET_STATE_DIR",
+              default=".fleet-state",
+              help="Executor state (step keys, artifacts, incumbent "
+                   "backups); re-runs execute only the stale subgraph")
+@click.option("--server-url", envvar="SERVER_BASE_URL", default=None,
+              help="Live replica to roll the fleet onto (canary + "
+                   "promote through its zero-downtime /reload swap); "
+                   "omitted = plan-only run (build + plan, no landing)")
+@click.option("--collection-dir", envvar="MODEL_COLLECTION_DIR", default=None,
+              help="The live server's artifact dir (required with "
+                   "--server-url)")
+@click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
+def workflow_run(machine_config, project_name, state_dir, server_url,
+                 collection_dir, model_register_dir):
+    """Compile AND execute a fleet spec: build -> bucket -> place ->
+    canary -> promote, goodput-judged with auto-rollback."""
+    from gordo_components_tpu.workflow import FleetExecutor, compile_fleet
+
+    try:
+        with open(machine_config) as f:
+            dag = compile_fleet(yaml.safe_load(f), project_name)
+        executor = FleetExecutor(
+            dag, state_dir, server_url=server_url,
+            collection_dir=collection_dir, register_dir=model_register_dir,
+        )
+    except (ValueError, yaml.YAMLError) as exc:
+        click.echo(f"Invalid fleet spec: {exc}", err=True)
+        sys.exit(EXIT_CONFIG_ERROR)
+    report = executor.run()
+    click.echo(json.dumps(report, indent=2, default=str))
+    if report["failed"]:
+        sys.exit(
+            EXIT_PARTIAL_BUILD if report["executed"] else EXIT_BUILD_ERROR
+        )
+
+
 @workflow_group.command("generate")
 @click.option("--machine-config", "-f", required=True, type=click.Path(exists=True))
 @click.option("--project-name", "-p", required=True)
@@ -379,7 +450,14 @@ def workflow_generate(machine_config, project_name, output_file, models_per_gang
         overrides["models_per_gang"] = models_per_gang
     if devices_per_gang:
         overrides["devices_per_gang"] = devices_per_gang
-    manifest = generate_workflow(config, project_name, **overrides)
+    try:
+        # generation now compiles the spec (fleet compiler validation
+        # included), so spec errors surface here too — same clean exit
+        # as `workflow compile` on the identical spec
+        manifest = generate_workflow(config, project_name, **overrides)
+    except ValueError as exc:
+        click.echo(f"Invalid fleet spec: {exc}", err=True)
+        sys.exit(EXIT_CONFIG_ERROR)
     if output_file:
         with open(output_file, "w") as f:
             f.write(manifest)

@@ -72,6 +72,34 @@ def state_digest(state_pytree: Any) -> str:
     return h.hexdigest()
 
 
+def payload_files_digest(state_dir: str) -> str:
+    """Content digest of every file orbax wrote under ``state_dir``
+    (sorted relative paths + raw bytes).
+
+    This exists because the pytree digest alone cannot prove the on-disk
+    payload is intact: orbax's ocdbt layout writes the array data into
+    several files (a per-process staging copy plus the merged store),
+    and a corrupted file the restore path happens not to read would slip
+    past a digest computed over the *restored* pytree. The file-level
+    digest covers every payload byte, so any flip under ``state/`` fails
+    validation before the checkpoint is trusted.
+    """
+    h = hashlib.sha256()
+    root = os.path.abspath(state_dir)
+    entries = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            entries.append((os.path.relpath(path, root), path))
+    for rel, path in sorted(entries):
+        h.update(rel.encode())
+        h.update(b"\0")
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
+
+
 def bucket_checkpoint_key(payload: Any, data=None) -> str:
     """Stable identity hash for a fleet bucket's training run.
 
@@ -148,6 +176,12 @@ class FleetBucketCheckpoint:
         payload = {"epoch": int(epoch), **host_state}
         if digest is not None:
             payload["state_digest"] = digest
+        # hashed at COMMIT time (after any async write finished), so the
+        # digest covers the final on-disk payload files — see
+        # payload_files_digest for why the pytree digest isn't enough
+        state_dir = os.path.join(edir, "state")
+        if os.path.isdir(state_dir):
+            payload["files_digest"] = payload_files_digest(state_dir)
         with open(host_path + ".tmp", "w") as f:
             json.dump(payload, f)
         os.replace(host_path + ".tmp", host_path)  # commit
@@ -233,6 +267,20 @@ class FleetBucketCheckpoint:
                 _FP_READ.fire()
                 with open(os.path.join(edir, "host.json")) as f:
                     host = json.load(f)
+                # file-level validation FIRST, before orbax touches the
+                # payload: a flipped byte in ANY state file (including
+                # ones this restore wouldn't read) fails here
+                expected_files = host.pop("files_digest", None)
+                if expected_files is not None and (
+                    payload_files_digest(os.path.join(edir, "state"))
+                    != expected_files
+                ):
+                    logger.warning(
+                        "Fleet checkpoint at %s FAILED payload-file digest "
+                        "validation (on-disk corruption); falling back to "
+                        "the next most recent valid checkpoint", edir,
+                    )
+                    continue
                 with ocp.PyTreeCheckpointer() as ckptr:
                     state = ckptr.restore(os.path.join(edir, "state"))
             except Exception:

@@ -7,6 +7,15 @@ from gordo_components_tpu.workflow.config import (
     NormalizedConfig,
 )
 from gordo_components_tpu.workflow.scheduler import Gang, schedule_gangs
+from gordo_components_tpu.workflow.canary import (
+    CanaryConfig,
+    CanarySignal,
+    CanaryVerdict,
+    judge_canary,
+)
+from gordo_components_tpu.workflow.dag import FleetDAG, Step
+from gordo_components_tpu.workflow.compiler import FleetSpec, compile_fleet
+from gordo_components_tpu.workflow.executor import FleetExecutor
 from gordo_components_tpu.workflow.generator import generate_workflow
 
 __all__ = [
@@ -16,4 +25,13 @@ __all__ = [
     "Gang",
     "schedule_gangs",
     "generate_workflow",
+    "FleetDAG",
+    "Step",
+    "FleetSpec",
+    "compile_fleet",
+    "FleetExecutor",
+    "CanaryConfig",
+    "CanarySignal",
+    "CanaryVerdict",
+    "judge_canary",
 ]

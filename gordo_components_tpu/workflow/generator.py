@@ -1,22 +1,30 @@
-"""Workflow generator: fleet config -> Kubernetes manifests.
+"""Workflow generator: the fleet DAG's Kubernetes manifest view.
 
 Reference parity: gordo_components/workflow/workflow_generator.py +
 templates/ (unverified; SURVEY.md §2 "workflow", §3.4) — pure in-process
 Jinja2 templating from normalized machine config to manifests on stdout.
 Where the reference renders an Argo Workflow with one builder pod per
-machine, this renders gang-scheduled TPU builder Jobs (see scheduler.py),
-one collection model-server Deployment per project, Ambassador mappings,
-and a Watchman deployment.
+machine, this renders gang-scheduled TPU builder Jobs, one collection
+model-server Deployment per project, Ambassador mappings, and a
+Watchman deployment.
+
+Since the fleet compiler landed (workflow/compiler.py) there is exactly
+ONE fleet-spec format: this module no longer buckets machines itself —
+it compiles the spec through :func:`compile_fleet` and renders the
+resulting DAG's ``bucket`` steps, so the manifests are a *view of the
+same DAG* the local executor runs. A spec that compiles identically
+deploys identically, whichever back end executes it; two divergent
+fleet-spec formats can never ship.
 """
 
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
 
 import jinja2
 
 from gordo_components_tpu.workflow.config import NormalizedConfig
-from gordo_components_tpu.workflow.scheduler import schedule_gangs
+from gordo_components_tpu.workflow.dag import FleetDAG
 
 _TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "templates")
 
@@ -42,13 +50,81 @@ DEFAULTS: Dict[str, Any] = {
 }
 
 
+def dag_manifest_view(dag: FleetDAG) -> list:
+    """The gang context the manifest template renders, read from a
+    compiled DAG's ``bucket`` steps (members reconstructed from each
+    bucket's ``build`` deps — the DAG is the single source of truth for
+    who builds with whom)."""
+    out = []
+    for bucket in dag.by_kind("bucket"):
+        # payload["members"] is the canonical member ORDER — deps are
+        # sorted on serialization, so a DAG round-tripped through JSON
+        # must render identically to a freshly compiled one
+        machines = [
+            dag.steps[f"build/{name}"].payload["machine"]
+            for name in bucket.payload["members"]
+        ]
+        payload = {
+            "gang_id": bucket.payload["gang_id"],
+            "n_features": bucket.payload["n_features"],
+            "machines": machines,
+        }
+        out.append(
+            {
+                "gang_id": bucket.payload["gang_id"],
+                "devices": bucket.payload["devices"],
+                # sort_keys: machine dicts reach here insertion-ordered
+                # from a fresh compile but key-sorted after a JSON
+                # round-trip — canonicalize so both render identically
+                "payload_json": json.dumps(payload, default=str, sort_keys=True),
+            }
+        )
+    return out
+
+
 def generate_workflow(
-    config: NormalizedConfig,
+    config: Union[NormalizedConfig, FleetDAG],
     project_name: str,
     **overrides: Any,
 ) -> str:
-    """Render the full multi-document manifest YAML for a project."""
-    params = {**DEFAULTS, **(config.runtime or {}), **overrides}
+    """Render the full multi-document manifest YAML for a project.
+
+    Accepts either a :class:`NormalizedConfig` (compiled to a
+    :class:`FleetDAG` first) or an already-compiled DAG — the manifests
+    are the DAG's k8s view either way."""
+    if isinstance(config, FleetDAG):
+        if "models_per_gang" in overrides or "devices_per_gang" in overrides:
+            # a compiled DAG's buckets are fixed — silently rendering the
+            # old gang sizing while the caller believes the override took
+            # would deploy at the wrong HBM/blast-radius bound
+            raise ValueError(
+                "models_per_gang/devices_per_gang cannot be overridden "
+                "when rendering an already-compiled FleetDAG; recompile "
+                "the spec with the override instead"
+            )
+        dag = config
+        # globals.runtime rode into the DAG meta at compile time, so a
+        # DAG loaded from fleet_dag.json renders with the same knobs as
+        # the original spec
+        runtime: Dict[str, Any] = dict(
+            (dag.meta.get("fleet") or {}).get("runtime") or {}
+        )
+    else:
+        from gordo_components_tpu.workflow.compiler import compile_fleet
+
+        runtime = config.runtime or {}
+        # bucket-sizing flows to the compiler ONLY as an explicit CALLER
+        # override: FleetSpec itself already resolves the spec's own
+        # precedence (fleet.models_per_bucket > globals.runtime >
+        # default), so re-injecting runtime here would flip it and make
+        # `workflow generate` disagree with `workflow compile`
+        compile_kw = {
+            k: int(v)
+            for k in ("models_per_gang", "devices_per_gang")
+            if (v := overrides.get(k)) is not None
+        }
+        dag = compile_fleet(config, project_name, **compile_kw)
+    params = {**DEFAULTS, **runtime, **overrides}
     # staging knobs deploy to EVERY builder pod: a typo here would
     # crashloop the whole fleet at stage time, so fail at generation
     if str(params["load_mode"]) not in ("auto", "thread", "process", "sync"):
@@ -66,28 +142,44 @@ def generate_workflow(
         raise ValueError(
             f"server_devices must be an integer, got {params['server_devices']!r}"
         )
-    gangs = schedule_gangs(
-        config.machines,
-        models_per_gang=int(params["models_per_gang"]),
-        devices_per_gang=int(params["devices_per_gang"]),
-    )
     env = jinja2.Environment(
         loader=jinja2.FileSystemLoader(_TEMPLATE_DIR),
         undefined=jinja2.StrictUndefined,
         keep_trailing_newline=True,
     )
     template = env.get_template("tpu-workflow.yaml.j2")
-    gang_ctx = [
-        {
-            "gang_id": g.gang_id,
-            "devices": g.devices,
-            "payload_json": json.dumps(g.to_manifest_payload(), default=str),
-        }
-        for g in gangs
-    ]
+    gang_ctx = dag_manifest_view(dag)
+    # the spec's declared SLO policy (fleet.slo, already validated by the
+    # compiler) deploys as every server replica's burn-engine config —
+    # the same objectives the canary judge reads back via GET /slo
+    fleet_meta = dag.meta.get("fleet") or {}
+    slo_objectives = fleet_meta.get("slo_objectives")
+    slo_windows = fleet_meta.get("slo_windows")
     return template.render(
         project_name=project_name,
-        n_machines=len(config.machines),
+        n_machines=len(dag.by_kind("build")),
         gangs=gang_ctx,
+        slo_objectives_json=(
+            json.dumps(
+                [
+                    # quantile must survive the render when declared: a
+                    # p99_latency_ms objective with an explicit 0.95
+                    # quantile deploys exactly as reviewed, never the
+                    # name-derived default
+                    {
+                        k: o[k]
+                        for k in ("name", "target", "quantile")
+                        if k in o
+                    }
+                    for o in slo_objectives
+                ],
+                sort_keys=True,
+            )
+            if slo_objectives
+            else None
+        ),
+        slo_windows=(
+            ",".join(str(w[0]) for w in slo_windows) if slo_windows else None
+        ),
         **{k: v for k, v in params.items() if k not in ("models_per_gang", "devices_per_gang")},
     )

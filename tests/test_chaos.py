@@ -43,6 +43,7 @@ EXPECTED_SITES = {
     "stream.refit",  # driven in tests/test_streaming.py (chaos mark)
     "watchman.scrape",
     "watchman.snapshot",
+    "workflow.canary",  # driven in tests/test_fleet_compiler.py (chaos mark)
 }
 
 
@@ -133,6 +134,7 @@ def test_every_failure_site_is_registered():
     import gordo_components_tpu.server.model_io  # noqa: F401
     import gordo_components_tpu.streaming  # noqa: F401
     import gordo_components_tpu.watchman.server  # noqa: F401
+    import gordo_components_tpu.workflow.canary  # noqa: F401
 
     assert EXPECTED_SITES <= set(resilience.registered_sites())
 
