@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -109,6 +109,16 @@ mesh:
 fleet:
 	$(PYTHON) -m pytest tests/ -q -m fleet --continue-on-collection-errors
 
+# history lane: the fleet flight recorder — retained metric history
+# (tiered rings, counter-delta rates, strict memory bound), the
+# structured event timeline (every state transition, ring-bounded),
+# watchman incident correlation (burn episodes x fleet events ->
+# GET /incidents), the canary history-window judge (single polls can
+# neither promote nor roll back), and the fleet /slo last-good
+# staleness contract (tests/test_history.py)
+history:
+	$(PYTHON) -m pytest tests/ -q -m history --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -190,6 +200,15 @@ mesh-demo:
 # bench.py's `fleet_compile` leg runs the compile-side measurements)
 fleet-demo:
 	$(PYTHON) tools/fleet_demo.py
+
+# game-day drill for the fleet flight recorder: injects scoring errors
+# (quarantine) + a queue stall vs tight deadlines (SLO burn) under live
+# load, recovers, then asks a real watchman /incidents for the
+# correlated fault -> burn -> quarantine -> recovery timeline; prints
+# one JSON doc (tools/incident_demo.py; bench.py's `history` leg runs
+# the same tool)
+incident-demo:
+	$(PYTHON) tools/incident_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -885,6 +885,51 @@ def bench_replay(epochs=3, speed=500.0):
     }
 
 
+def bench_history(burn_seconds=2.0):
+    """Fleet flight recorder (ISSUE 16) — the game-day drill via
+    tools/incident_demo.py: scoring-error + queue-stall faults under
+    live load, recovery, then a real watchman ``/incidents``
+    correlation. Records the recorder's cost figures (sampler ms per
+    pass, /history query ms, retained bytes per series) and the
+    detection outcome (incidents found, peak burn, the correlated event
+    types). Subprocess so the GORDO_HISTORY/GORDO_SLO env knobs land
+    before server import."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "incident_demo.py"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, tool, "--burn-seconds", str(burn_seconds),
+            "--platform", "cpu",
+        ],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"incident demo failed: {' | '.join(tail[-3:])}")
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    assert doc["passed"], doc
+    assert doc["detected"] >= 1, doc["detected"]
+    # the recorder must stay cheap: one full-registry sample pass in
+    # single-digit ms, queries in low ms, a bounded per-series footprint
+    assert doc["sample_ms_avg"] < 50.0, doc["sample_ms_avg"]
+    return {
+        "history_incidents_detected": doc["detected"],
+        "history_burn_episodes": doc["episodes"],
+        "history_peak_burn": doc["peak_burn"],
+        "history_event_types_correlated": doc["incident_event_types"],
+        "history_sample_ms_avg": doc["sample_ms_avg"],
+        "history_query_ms": doc["query_ms"],
+        "history_bytes_per_series": doc["bytes_per_series"],
+        "history_series_retained": doc["history_series"],
+        "history_timeline_len": len(doc["timeline"]),
+        "history": doc,
+    }
+
+
 def bench_fleet_compile(members_compile=2048, demo_members=8):
     """Declarative fleet compiler (ISSUE 15) — two measurements:
 
@@ -1631,6 +1676,7 @@ METRICS = (
     ("streaming", bench_streaming),
     ("replay", bench_replay),
     ("fleet_compile", bench_fleet_compile),
+    ("history", bench_history),
     ("serving_saturation", bench_serving_saturation),
     ("mesh_serving", bench_mesh_serving),
     ("model_zoo", bench_sequence_models),

@@ -8,6 +8,12 @@ endpoint and the ``/metrics`` scrape endpoint read the same underlying
 integers and can never drift.
 """
 
+from gordo_components_tpu.observability.events import (
+    Event,
+    EventLog,
+    get_event_log,
+    set_event_log,
+)
 from gordo_components_tpu.observability.goodput import (
     GoodputLedger,
     attribute_trace,
@@ -23,6 +29,10 @@ from gordo_components_tpu.observability.slo import (
     SLOTracker,
     merge_slo_snapshots,
 )
+from gordo_components_tpu.observability.timeseries import (
+    HistoryStore,
+    history_from_env,
+)
 from gordo_components_tpu.observability.tracing import (
     Span,
     Trace,
@@ -36,8 +46,11 @@ from gordo_components_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "Event",
+    "EventLog",
     "GoodputLedger",
     "Histogram",
+    "HistoryStore",
     "MetricsRegistry",
     "SLOTracker",
     "Span",
@@ -47,11 +60,14 @@ __all__ = [
     "chrome_trace",
     "current_trace",
     "format_traceparent",
+    "get_event_log",
     "get_registry",
     "get_tracer",
+    "history_from_env",
     "merge_slo_snapshots",
     "parse_prometheus_text",
     "parse_traceparent",
     "render_samples",
+    "set_event_log",
     "use_trace",
 ]

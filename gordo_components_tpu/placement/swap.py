@@ -232,12 +232,31 @@ def swap_bank(
             "generation %d (old bank keeps serving)",
             generation, old_generation, exc_info=True,
         )
+        events = app.get("events")
+        if events is not None:
+            events.emit(
+                "bank.swap_failed",
+                severity="error",
+                generation=old_generation,
+                attempted=generation,
+            )
         raise
     pause_s = time.monotonic() - t0
     logger.info(
         "bank swapped to generation %d (%d model(s), flip pause %.3fms)",
         generation, len(new_bank), pause_s * 1e3,
     )
+    events = app.get("events")
+    if events is not None:
+        # the ONE anchor every generation change shares (/reload,
+        # rebalance, adapt, mesh acquire/release all land here), so the
+        # timeline records every swap exactly once
+        events.emit(
+            "bank.swap",
+            generation=generation,
+            models=len(new_bank),
+            pause_ms=round(pause_s * 1e3, 3),
+        )
     return SwapResult(
         generation=generation,
         pause_s=pause_s,

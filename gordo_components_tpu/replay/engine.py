@@ -179,9 +179,8 @@ class ReplayEngine:
         from gordo_components_tpu.resilience import faults
         from gordo_components_tpu.server import build_app
 
-        clock = ReplayClock(
-            float(self.start.value) / 1e9, speed=self.speed
-        )
+        start_epoch = float(self.start.value) / 1e9
+        clock = ReplayClock(start_epoch, speed=self.speed)
         app = build_app(self.root, devices=self.devices, clock=clock)
         client = TestClient(TestServer(app))
         await client.start_server()
@@ -521,6 +520,19 @@ class ReplayEngine:
             slo = app.get("slo")
             if slo is not None:
                 verdict["slo_worst_burn"] = (slo.snapshot().get("worst") or {})
+            events = app.get("events")
+            if events is not None:
+                # per-scenario flight-recorder timeline: every swap /
+                # drift flag / quarantine / fault fire the run produced,
+                # rendered relative to replay t=0 (events are stamped on
+                # the replay clock, so offsets ARE event time)
+                from gordo_components_tpu.watchman.correlate import (
+                    render_timeline,
+                )
+
+                evs = events.events()
+                verdict["events"] = evs
+                verdict["timeline"] = render_timeline(start_epoch, evs)
         finally:
             wall = max(1e-9, time.monotonic() - wall_t0)
             verdict["wall_seconds"] = round(wall, 3)

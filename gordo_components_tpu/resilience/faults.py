@@ -56,7 +56,26 @@ __all__ = [
     "faultpoint",
     "registered_sites",
     "reset",
+    "set_fire_listener",
 ]
+
+# one process-wide observer invoked on every ACTUAL fire (after the
+# p/times gates pass, before any delay/raise) — the event timeline's
+# hook. A plain module global read once per armed fire: the disabled
+# hot path (spec is None) never reaches it, preserving the 5% guard.
+_FIRE_LISTENER: Optional[Callable[[str, "FaultSpec"], None]] = None
+
+
+def set_fire_listener(
+    fn: Optional[Callable[[str, "FaultSpec"], None]]
+) -> Optional[Callable[[str, "FaultSpec"], None]]:
+    """Install (or clear with None) the fire observer; returns the
+    previous one. Listener exceptions are swallowed — observability must
+    never change injected-fault semantics."""
+    global _FIRE_LISTENER
+    prev = _FIRE_LISTENER
+    _FIRE_LISTENER = fn
+    return prev
 
 
 class FaultInjected(RuntimeError):
@@ -112,6 +131,12 @@ class FaultSpec:
                 return
             self.remaining -= 1
         self.fired += 1
+        listener = _FIRE_LISTENER
+        if listener is not None:
+            try:
+                listener(site, self)
+            except Exception:
+                pass
         if self.delay_s > 0:
             time.sleep(self.delay_s)
         if self.exc is not None:

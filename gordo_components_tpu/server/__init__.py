@@ -542,6 +542,79 @@ def build_app(
             len(owned), len(roster),
         )
     app["mesh"] = mesh_identity
+    # flight recorder (docs/observability.md "Flight recorder"): the
+    # structured event log is ALWAYS on — state transitions are rare
+    # (swaps, reloads, quarantines), so one locked deque append per
+    # transition is noise and the timeline is there when the incident
+    # hits. The metric history store is GORDO_HISTORY-gated and None
+    # when off; call sites pay one `is None` check (the disabled
+    # contract the hot-loop guard enforces).
+    from gordo_components_tpu.observability.events import EventLog
+    from gordo_components_tpu.observability.timeseries import history_from_env
+
+    replica_name = (
+        f"replica-{mesh_identity.replica_id}" if mesh_identity is not None else None
+    )
+    events = EventLog(clock=app["clock"], replica=replica_name)
+    events.attach_registry(registry)
+    app["events"] = events
+    history = history_from_env(registry, clock=app["clock"])
+    app["history"] = history
+    if history is not None:
+
+        async def _start_history_sampler(app: web.Application) -> None:
+            store = app["history"]
+            store.sample()  # boot baseline: rates start on the 2nd pass
+
+            async def _tick():
+                # cadence in seam seconds, like the SLO sampler: a replay
+                # clock compresses the real sleep so samples land every
+                # interval_s of REPLAYED time
+                real_sleep = store.interval_s / max(1.0, app["clock"].timescale)
+                while True:
+                    await asyncio.sleep(real_sleep)
+                    store.sample()
+
+            app["history_sampler"] = asyncio.get_running_loop().create_task(_tick())
+
+        async def _stop_history_sampler(app: web.Application) -> None:
+            import contextlib
+
+            task = app.get("history_sampler")
+            if task is not None:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+
+        app.on_startup.append(_start_history_sampler)
+        app.on_cleanup.append(_stop_history_sampler)
+
+    # fault fires land on the timeline (armed sites only — the disarmed
+    # hot path never reaches the listener). Process-global seam, so the
+    # most recently built app owns it; uninstall on cleanup only if it
+    # is still ours (many short-lived apps per test process)
+    from gordo_components_tpu.resilience.faults import set_fire_listener
+
+    def _on_fault_fire(site: str, spec) -> None:
+        events.emit(
+            "fault.fired",
+            severity="warning",
+            generation=app.get("bank_generation"),
+            site=site,
+            fired=spec.fired,
+        )
+
+    async def _install_fault_listener(app: web.Application) -> None:
+        set_fire_listener(_on_fault_fire)
+
+    async def _uninstall_fault_listener(app: web.Application) -> None:
+        from gordo_components_tpu.resilience import faults as _faults
+
+        if _faults._FIRE_LISTENER is _on_fault_fire:
+            set_fire_listener(None)
+
+    app.on_startup.append(_install_fault_listener)
+    app.on_cleanup.append(_uninstall_fault_listener)
     collection = ModelCollection(model_dir, target_name=target_name, owned=owned)
     app["collection"] = collection
     # per-model scoring-failure breaker (resilience/quarantine.py): a

@@ -21,7 +21,7 @@ import logging
 import math
 import os
 import time
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 import pandas as pd
@@ -171,7 +171,16 @@ def _note_scoring_result(
     if finite:
         quarantine.record_success(target)
     elif input_finite:
-        quarantine.record_failure(target, "non-finite scores in model output")
+        if quarantine.record_failure(
+            target, "non-finite scores in model output"
+        ):
+            _emit_event(
+                request.app,
+                "quarantine.enter",
+                severity="error",
+                target=target,
+                reason="non-finite scores in model output",
+            )
 
 
 def _note_scoring_error(request: web.Request, target: str, exc: Exception) -> None:
@@ -185,7 +194,30 @@ def _note_scoring_error(request: web.Request, target: str, exc: Exception) -> No
         exc, (ValueError, KeyError, DeadlineExceeded)
     ):
         return
-    quarantine.record_failure(target, f"{type(exc).__name__}: {exc}")
+    if quarantine.record_failure(target, f"{type(exc).__name__}: {exc}"):
+        _emit_event(
+            request.app,
+            "quarantine.enter",
+            severity="error",
+            target=target,
+            reason=f"{type(exc).__name__}: {exc}",
+        )
+
+
+def _emit_event(
+    app: web.Application, etype: str, severity: str = "info", **attrs
+) -> None:
+    """Stamp a state transition onto the flight-recorder timeline
+    (observability/events.py), tagged with the current bank generation.
+    Absent log (apps built before the recorder, bare test apps) = no-op."""
+    events = app.get("events")
+    if events is not None:
+        events.emit(
+            etype,
+            severity=severity,
+            generation=app.get("bank_generation"),
+            **attrs,
+        )
 
 
 def _http_overloaded(exc: EngineOverloaded) -> web.HTTPTooManyRequests:
@@ -376,6 +408,8 @@ async def quarantine_clear(request: web.Request) -> web.Response:
                     content_type="application/json",
                 )
     cleared = quarantine.clear(targets)
+    if cleared:
+        _emit_event(request.app, "quarantine.clear", targets=cleared)
     return web.json_response({"enabled": True, "cleared": cleared})
 
 
@@ -487,6 +521,70 @@ async def slo_view(request: web.Request) -> web.Response:
     ledger = request.app.get("goodput")
     if ledger is not None:
         body["goodput"] = ledger.snapshot()
+    return web.json_response(body)
+
+
+def _query_float(request: web.Request, name: str) -> Optional[float]:
+    raw = request.query.get(name)
+    if raw in (None, ""):
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"{name} must be a number, got {raw!r}"}),
+            content_type="application/json",
+        )
+
+
+@routes.get("/gordo/v0/{project}/history")
+async def history_view(request: web.Request) -> web.Response:
+    """Retained metric history (observability/timeseries.py): the
+    flight recorder's time axis. Without ``?series=``, the store meta +
+    retained series names; with ``?series=a,b`` (plus optional
+    ``since``/``until`` epoch seconds and ``step`` seconds), the points
+    from the finest tier that covers the range. Disabled
+    (``GORDO_HISTORY`` unset) answers ``{"enabled": false}`` — the
+    watchman rollup counts such replicas out instead of erroring."""
+    store = request.app.get("history")
+    if store is None:
+        return web.json_response({"enabled": False})
+    body: Dict[str, Any] = store.snapshot()
+    series_raw = request.query.get("series", "")
+    names = [s for s in series_raw.split(",") if s]
+    if names:
+        body["series"] = store.query(
+            names,
+            since=_query_float(request, "since"),
+            until=_query_float(request, "until"),
+            step=_query_float(request, "step"),
+        )
+    else:
+        body["names"] = store.series_names()
+    return web.json_response(body)
+
+
+@routes.get("/gordo/v0/{project}/events")
+async def events_view(request: web.Request) -> web.Response:
+    """Structured event timeline (observability/events.py): every state
+    transition this replica performed — swaps, reloads, quarantine
+    flips, mesh moves, canary/fault activity — oldest-first. Filters:
+    ``?since=<seq>`` (resume a tail), ``?since_wall=<epoch s>``,
+    ``?type=a,b`` (comma-separated), ``?limit=n`` (newest n)."""
+    events = request.app.get("events")
+    if events is None:
+        return web.json_response({"enabled": False, "events": []})
+    types_raw = request.query.get("type", "")
+    types = [t for t in types_raw.split(",") if t] or None
+    since_seq = _query_float(request, "since") or 0
+    limit = _query_float(request, "limit")
+    body = {"enabled": True, **events.snapshot()}
+    body["events"] = events.events(
+        since_seq=int(since_seq),
+        types=types,
+        since_wall=_query_float(request, "since_wall"),
+        limit=None if limit is None else int(limit),
+    )
     return web.json_response(body)
 
 
@@ -726,6 +824,13 @@ async def reload_models(request: web.Request) -> web.Response:
             for name in changes["updated"] + changes["removed"]:
                 quarantine.drop(name)
         bank_models, swap_info = await _swap_collection_bank(app, loop)
+    _emit_event(
+        app,
+        "models.reload",
+        added=len(changes.get("added", ())),
+        updated=len(changes.get("updated", ())),
+        removed=len(changes.get("removed", ())),
+    )
     body = {
         "changes": changes,
         "models": collection.names(),
@@ -796,6 +901,13 @@ async def rebalance(request: web.Request) -> web.Response:
                 "request_id": request.get("request_id"),
             },
             status=500,
+        )
+    if not dry_run:
+        _emit_event(
+            request.app,
+            "rebalance.applied" if result.get("applied") else "rebalance.plan",
+            moves=len((result.get("plan") or {}).get("moves") or ()),
+            applied=bool(result.get("applied")),
         )
     return web.json_response(result)
 
@@ -1032,6 +1144,9 @@ async def mesh_acquire(request: web.Request) -> web.Response:
                 },
                 status=500,
             )
+    _emit_event(
+        app, "mesh.acquire", member=member, shipped=bool(source)
+    )
     return web.json_response(
         {
             "acquired": True,
@@ -1103,6 +1218,7 @@ async def mesh_release(request: web.Request) -> web.Response:
                 },
                 status=500,
             )
+    _emit_event(app, "mesh.release", member=member)
     return web.json_response(
         {
             "released": True,

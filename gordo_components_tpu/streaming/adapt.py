@@ -251,9 +251,26 @@ class StreamingPlane:
     # ------------------------- drift evaluation ------------------------ #
 
     async def evaluate(self) -> Dict[str, Any]:
-        """Run one drift sweep off the event loop."""
+        """Run one drift sweep off the event loop. Drift-state EDGES
+        (a member newly flagged or newly recovered) land on the flight
+        recorder — the sweep itself is steady-state and does not."""
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.detector.evaluate)
+        before = set(self.detector.drifted_members())
+        result = await loop.run_in_executor(None, self.detector.evaluate)
+        events = self.app.get("events")
+        if events is not None:
+            after = set(self.detector.drifted_members())
+            generation = self.app.get("bank_generation")
+            for name in sorted(after - before):
+                events.emit(
+                    "drift.flagged",
+                    severity="warning",
+                    generation=generation,
+                    target=name,
+                )
+            for name in sorted(before - after):
+                events.emit("drift.cleared", generation=generation, target=name)
+        return result
 
     def drift_view(self) -> Dict[str, Any]:
         body = self.detector.view()
@@ -430,6 +447,16 @@ class StreamingPlane:
                     _restore_collectors(registry, prev_collectors)
                     self.stats["refit_failed"] += 1
                     self.stats["last_error"] = f"{type(exc).__name__}: {exc}"
+                    events = app.get("events")
+                    if events is not None:
+                        events.emit(
+                            "adapt.rolled_back",
+                            severity="error",
+                            generation=app.get("bank_generation"),
+                            mode=mode,
+                            members=sorted(updates),
+                            error=f"{type(exc).__name__}: {exc}",
+                        )
                     raise
                 controller = app.get("placement")
                 if controller is not None:
@@ -456,6 +483,13 @@ class StreamingPlane:
                     st.ewma_total = None
                     st.drift_score = None
                     st.drifted = False
+            events = app.get("events")
+            if events is not None:
+                events.emit(
+                    f"adapt.{mode}",
+                    generation=app.get("bank_generation"),
+                    members=sorted(updates),
+                )
             body: Dict[str, Any] = {
                 "applied": True,
                 "mode": mode,

@@ -26,9 +26,15 @@ from aiohttp import web
 
 from gordo_components_tpu import __version__
 from gordo_components_tpu.observability import (
+    EventLog,
     merge_slo_snapshots,
     parse_prometheus_text,
     render_samples,
+)
+from gordo_components_tpu.watchman.correlate import (
+    DEFAULT_BURN_THRESHOLD,
+    burn_episodes,
+    group_incidents,
 )
 from gordo_components_tpu.replay.clock import SYSTEM_CLOCK
 from gordo_components_tpu.resilience.deadline import Deadline
@@ -274,6 +280,12 @@ class WatchmanState:
         # numbers are frozen" is an alertable gauge, not a mystery
         self._metrics_last_success: List[Optional[float]] = []
         self._metrics_task: Optional[asyncio.Task] = None
+        # fleet_slo's half of the same last-good contract: an
+        # unreachable replica's burn state must not silently vanish from
+        # the merge (its budget is still burning!) — serve its last-good
+        # body stamped stale/stale_seconds instead
+        self._slo_last_bodies: List[Optional[Dict[str, Any]]] = []
+        self._slo_last_success: List[Optional[float]] = []
         # digest polling by default (VERDICT r3 next #5): a 10k-model
         # snapshot with per-epoch training histories is tens of MB of JSON
         # encoded on the SERVING process every refresh; the digest keeps
@@ -329,6 +341,10 @@ class WatchmanState:
         self.mesh_min_rows = int(
             env_num("GORDO_MESH_MIN_ROWS", 1024.0, float)
         )
+        # watchman's own slice of the fleet timeline: control-plane
+        # transitions it performs itself (migrations) land here and
+        # merge into GET /events and /incidents next to replica events
+        self.events = EventLog(clock=self.clock, replica="watchman")
 
     def _url(self, target: str, endpoint: str) -> str:
         return f"{self.base_url}/gordo/v0/{self.project}/{target}/{endpoint}"
@@ -534,17 +550,209 @@ class WatchmanState:
                     return None
 
             bodies = list(await asyncio.gather(*(fetch(u) for u in urls)))
+        # last-good substitution (the /metrics rollup's contract, applied
+        # to /slo): an unreachable replica keeps contributing its last
+        # successful body — frozen burn state beats a silent vanish from
+        # the fleet sums — stamped stale/stale_seconds so the
+        # substitution is an alertable signal, never a mystery
+        live = [body is not None for body in bodies]
+        mono = self.clock.monotonic()
+        succ = self._slo_last_success
+        succ.extend([None] * (len(bodies) - len(succ)))
+        for i, body in enumerate(bodies):
+            if body is not None:
+                succ[i] = mono
+        last = self._slo_last_bodies
+        bodies = [
+            b if b is not None else (last[i] if i < len(last) else None)
+            for i, b in enumerate(bodies)
+        ]
+        self._slo_last_bodies = bodies
         merged = merge_slo_snapshots(bodies)
         merged["replicas"] = [
             {
                 "replica": i,
-                "scraped": body is not None,
+                "scraped": live[i],
+                "stale": body is not None and not live[i],
+                "stale_seconds": (
+                    round(mono - succ[i], 3)
+                    if not live[i] and succ[i] is not None
+                    else None
+                ),
                 "slo_enabled": bool(body and body.get("enabled")),
                 "worst": (body or {}).get("worst"),
             }
             for i, body in enumerate(bodies)
         ]
+        merged["replicas_scraped"] = sum(live)
         return merged
+
+    # ------------------------------------------------------------------ #
+    # fleet flight recorder: history + events rollups, incident join
+    # ------------------------------------------------------------------ #
+
+    async def _fetch_replica_json(
+        self, suffix: str, params_per_replica=None
+    ) -> List[Optional[Dict[str, Any]]]:
+        """Best-effort ``GET <replica>/<suffix>`` across the fleet: one
+        body (or None) per replica, in replica order.
+        ``params_per_replica`` maps replica index -> query params; an
+        index with params ``False`` is skipped (stays None)."""
+        prefixes = self._replica_prefixes()
+        timeout = aiohttp.ClientTimeout(total=30)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def fetch(i, url):
+                params = (
+                    params_per_replica.get(i)
+                    if params_per_replica is not None
+                    else None
+                )
+                if params is False:
+                    return None
+
+                async def get():
+                    async with session.get(url, params=params) as resp:
+                        if resp.status != 200:
+                            return None
+                        return await resp.json()
+
+                try:
+                    return await Deadline(10.0).wait_for(get())
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.debug("%s fetch failed for %s: %s", suffix, url, exc)
+                    return None
+
+            return list(
+                await asyncio.gather(
+                    *(
+                        fetch(i, f"{p}/{suffix}")
+                        for i, p in enumerate(prefixes)
+                    )
+                )
+            )
+
+    async def fleet_history(
+        self,
+        series: Optional[List[str]] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        step: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Fleet history rollup: every replica's ``GET /history`` body,
+        per replica (series stay attributed to the replica that
+        recorded them — summing retained gauges across replicas would
+        manufacture numbers nobody measured). Replicas with history
+        disabled answer ``enabled: false`` and contribute nothing."""
+        params: Dict[str, str] = {}
+        if series:
+            params["series"] = ",".join(series)
+        for key, val in (("since", since), ("until", until), ("step", step)):
+            if val is not None:
+                params[key] = str(val)
+        shared = {i: (params or None) for i in range(len(self._replica_prefixes()))}
+        bodies = await self._fetch_replica_json("history", shared)
+        return {
+            "replicas_scraped": sum(1 for b in bodies if b is not None),
+            "replicas": [
+                {
+                    "replica": i,
+                    "scraped": b is not None,
+                    **(b if b is not None else {"enabled": False}),
+                }
+                for i, b in enumerate(bodies)
+            ],
+        }
+
+    async def fleet_events(
+        self,
+        since_wall: Optional[float] = None,
+        types: Optional[List[str]] = None,
+        limit: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Fleet event rollup: every replica's ``GET /events`` merged
+        with watchman's own control-plane log (migrations), ordered by
+        wall time. Each event gains ``replica_index``; events a replica
+        emitted without a replica name get ``replica-<i>``."""
+        params: Dict[str, str] = {}
+        if since_wall is not None:
+            params["since_wall"] = str(since_wall)
+        if types:
+            params["type"] = ",".join(types)
+        shared = {i: (params or None) for i in range(len(self._replica_prefixes()))}
+        bodies = await self._fetch_replica_json("events", shared)
+        merged: List[Dict[str, Any]] = []
+        for i, body in enumerate(bodies):
+            for ev in (body or {}).get("events") or ():
+                ev = dict(ev, replica_index=i)
+                if not ev.get("replica"):
+                    ev["replica"] = f"replica-{i}"
+                merged.append(ev)
+        merged.extend(
+            self.events.events(types=types, since_wall=since_wall)
+        )
+        merged.sort(
+            key=lambda ev: (float(ev.get("wall", 0)), ev.get("seq", 0))
+        )
+        if limit is not None and limit >= 0:
+            merged = merged[-limit:]
+        return {
+            "replicas_scraped": sum(1 for b in bodies if b is not None),
+            "events": merged,
+        }
+
+    async def fleet_incidents(
+        self,
+        threshold: Optional[float] = None,
+        margin_s: Optional[float] = None,
+        min_points: int = 1,
+    ) -> Dict[str, Any]:
+        """The flight-recorder join (watchman/correlate.py): find every
+        replica's SLO-burn episodes in its retained
+        ``gordo_slo_burn_rate`` history, group overlapping episodes
+        fleet-wide into incidents, and attach the fleet event timeline
+        that overlaps each one. Needs ``GORDO_HISTORY=1`` on the
+        replicas — without it there is no retained burn series and the
+        body says so instead of detecting nothing silently."""
+        thr = DEFAULT_BURN_THRESHOLD if threshold is None else float(threshold)
+        margin = 30.0 if margin_s is None else float(margin_s)
+        metas = await self._fetch_replica_json("history")
+        wanted: Dict[int, Any] = {}
+        for i, meta in enumerate(metas):
+            has_burn = any(
+                n.startswith("gordo_slo_burn_rate")
+                for n in ((meta or {}).get("names") or ())
+            )
+            # the base name expands server-side to every retained
+            # objective/window label set (full keys contain commas)
+            wanted[i] = {"series": "gordo_slo_burn_rate"} if has_burn else False
+        history_enabled = sum(
+            1 for m in metas if m is not None and m.get("enabled")
+        )
+        episodes: List[Dict[str, Any]] = []
+        if any(p is not False for p in wanted.values()):
+            bodies = await self._fetch_replica_json("history", wanted)
+            for i, body in enumerate(bodies):
+                for name, rec in ((body or {}).get("series") or {}).items():
+                    for ep in burn_episodes(
+                        rec.get("points") or (), thr, min_points
+                    ):
+                        ep["series"] = name
+                        ep["replica"] = i
+                        episodes.append(ep)
+        events_body = await self.fleet_events()
+        incidents = group_incidents(episodes, events_body["events"], margin)
+        return {
+            "incidents": incidents,
+            "detected": len(incidents),
+            "episodes": len(episodes),
+            "threshold": thr,
+            "margin_s": margin,
+            "replicas_with_history": history_enabled,
+            "replicas_scraped": events_body["replicas_scraped"],
+        }
 
     async def fleet_drift(
         self, refresh: bool = False, wait: bool = True
@@ -1081,6 +1289,13 @@ class WatchmanState:
                         moved=False,
                         error=f"acquire failed: {type(exc).__name__}: {exc}",
                     )
+                    self.events.emit(
+                        "mesh.migrate_failed",
+                        severity="error",
+                        member=member,
+                        dst=dst,
+                        error=verdict["error"],
+                    )
                     return verdict
                 verdict["acquire"] = {
                     "status": status,
@@ -1093,6 +1308,13 @@ class WatchmanState:
                         moved=False,
                         error=f"acquire answered {status}: "
                               f"{body.get('error')}",
+                    )
+                    self.events.emit(
+                        "mesh.migrate_failed",
+                        severity="error",
+                        member=member,
+                        dst=dst,
+                        error=verdict["error"],
                     )
                     return verdict
                 # destination owns it: flip routing BEFORE the release
@@ -1124,6 +1346,13 @@ class WatchmanState:
             self._migrations_total += 1
             await self.routing(refresh=True)
             verdict.update(moved=True, routing_version=self._routing_version)
+            self.events.emit(
+                "mesh.migrate",
+                member=member,
+                src=src,
+                dst=dst,
+                dual_owned="warning" in verdict,
+            )
             return verdict
 
     async def fleet_rebalance_cross(
@@ -1619,6 +1848,70 @@ def build_watchman_app(
         rollup = await state.fleet_drift(refresh=refresh)
         return web.json_response(rollup)
 
+    def _q_float(request: web.Request, name: str) -> Optional[float]:
+        raw = request.query.get(name)
+        if raw is None or raw == "":
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text='{"error": "%s must be a number"}' % name,
+                content_type="application/json",
+            )
+
+    async def history(request: web.Request) -> web.Response:
+        """Fleet metric-history rollup: every replica's retained rings,
+        attributed per replica. ``?series=a,b&since=&until=&step=``
+        forward to each replica's ``GET /history``."""
+        raw_series = request.query.get("series")
+        series = (
+            [s for s in raw_series.split(",") if s] if raw_series else None
+        )
+        return web.json_response(
+            await state.fleet_history(
+                series=series,
+                since=_q_float(request, "since"),
+                until=_q_float(request, "until"),
+                step=_q_float(request, "step"),
+            )
+        )
+
+    async def events(request: web.Request) -> web.Response:
+        """Fleet event timeline: every replica's structured events plus
+        the watchman's own (migrations), merged on wall time.
+        ``?type=a,b&since_wall=&limit=`` filter the merge."""
+        raw_types = request.query.get("type")
+        types = [t for t in raw_types.split(",") if t] if raw_types else None
+        raw_limit = request.query.get("limit")
+        try:
+            limit = int(raw_limit) if raw_limit else None
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text='{"error": "limit must be an integer"}',
+                content_type="application/json",
+            )
+        return web.json_response(
+            await state.fleet_events(
+                since_wall=_q_float(request, "since_wall"),
+                types=types,
+                limit=limit,
+            )
+        )
+
+    async def incidents(request: web.Request) -> web.Response:
+        """The flight-recorder join: SLO-burn episodes detected in the
+        fleet's retained history, grouped into incidents, each with the
+        ordered event timeline that overlaps it. ``?threshold=`` (burn
+        floor, default 1.0) and ``?margin=`` (grouping/attachment window
+        seconds, default 30) tune the correlation."""
+        return web.json_response(
+            await state.fleet_incidents(
+                threshold=_q_float(request, "threshold"),
+                margin_s=_q_float(request, "margin"),
+            )
+        )
+
     async def routing_view(request: web.Request) -> web.Response:
         """The versioned routing table (multi-host serving): member ->
         owning replica + per-replica health. ``ETag``-conditional: pass
@@ -1722,6 +2015,9 @@ def build_watchman_app(
     app.router.add_get("/traces", traces)
     app.router.add_get("/slo", slo)
     app.router.add_get("/drift", drift)
+    app.router.add_get("/history", history)
+    app.router.add_get("/events", events)
+    app.router.add_get("/incidents", incidents)
     app.router.add_post("/rebalance", rebalance)
     app.router.add_get("/routing", routing_view)
     app.router.add_post("/migrate", migrate)

@@ -35,9 +35,11 @@ from gordo_components_tpu.resilience.faults import faultpoint
 
 __all__ = [
     "CanaryConfig",
+    "CanaryHistory",
     "CanarySignal",
     "CanaryVerdict",
     "judge_canary",
+    "judge_canary_window",
     "signal_delta",
 ]
 
@@ -54,6 +56,8 @@ _CANARY_KEYS = {
     "window_s",
     "poll_s",
     "min_requests",
+    "min_samples",
+    "burn_polls",
     "fast_burn_threshold",
     "max_goodput_drop",
     "max_success_drop",
@@ -80,6 +84,10 @@ class CanaryConfig:
     window_s: float = 30.0       # observation window after the slice swap
     poll_s: float = 1.0          # fast-burn poll cadence inside the window
     min_requests: int = 1        # below this the window is no-signal
+    # history-window judging (judge_canary_window): the verdict needs a
+    # retained multi-sample window, not one lucky poll —
+    min_samples: int = 3         # polls observed before promote is possible
+    burn_polls: int = 2          # consecutive burning polls before rollback
     fast_burn_threshold: float = DEFAULT_FAST_BURN
     max_goodput_drop: float = 0.05   # wall-goodput ratio tolerance vs incumbent
     max_success_drop: float = 0.02   # request-success ratio tolerance
@@ -130,6 +138,18 @@ class CanaryConfig:
                     default("GORDO_FLEET_CANARY_MIN_REQUESTS", cls.min_requests),
                 )
             ),
+            min_samples=int(
+                spec.get(
+                    "min_samples",
+                    default("GORDO_FLEET_CANARY_MIN_SAMPLES", cls.min_samples),
+                )
+            ),
+            burn_polls=int(
+                spec.get(
+                    "burn_polls",
+                    default("GORDO_FLEET_CANARY_BURN_POLLS", cls.burn_polls),
+                )
+            ),
             fast_burn_threshold=float(
                 spec.get(
                     "fast_burn_threshold",
@@ -157,6 +177,8 @@ class CanaryConfig:
             raise ValueError("canary window_s must be >= 0 and poll_s > 0")
         if cfg.min_requests < 1:
             raise ValueError("canary min_requests must be >= 1")
+        if cfg.min_samples < 1 or cfg.burn_polls < 1:
+            raise ValueError("canary min_samples and burn_polls must be >= 1")
         if cfg.fast_burn_threshold <= 0:
             raise ValueError("canary fast_burn_threshold must be > 0")
         return cfg
@@ -167,6 +189,8 @@ class CanaryConfig:
             "window_s": self.window_s,
             "poll_s": self.poll_s,
             "min_requests": self.min_requests,
+            "min_samples": self.min_samples,
+            "burn_polls": self.burn_polls,
             "fast_burn_threshold": self.fast_burn_threshold,
             "max_goodput_drop": self.max_goodput_drop,
             "max_success_drop": self.max_success_drop,
@@ -331,3 +355,114 @@ def judge_canary(
         f"canary healthy over {int(canary_window.requests_total)} request(s)",
         metrics,
     )
+
+
+class CanaryHistory:
+    """The retained multi-sample canary window: every judge poll's
+    cumulative signal + burn observation, in order. This is the flight
+    recorder applied to rollouts — :func:`judge_canary_window` reads the
+    WHOLE window (aggregate delta, burn persistence, sample count)
+    where the old single-poll path read only whatever the last ``/slo``
+    body happened to say."""
+
+    __slots__ = ("at_swap", "times", "signals", "burns")
+
+    def __init__(self, at_swap: CanarySignal):
+        self.at_swap = at_swap
+        self.times: list = []
+        self.signals: list = []
+        self.burns: list = []  # Optional[str] per poll
+
+    def add(
+        self,
+        t: float,
+        signal: CanarySignal,
+        burning_objective: Optional[str] = None,
+    ) -> None:
+        self.times.append(float(t))
+        self.signals.append(signal)
+        self.burns.append(burning_objective)
+
+    @property
+    def n_samples(self) -> int:
+        return len(self.signals)
+
+    def window_delta(self) -> CanarySignal:
+        """Aggregate signal over the full observed window (cumulative
+        last sample minus the at-swap baseline) — inherently every
+        poll's traffic, not one poll's luck."""
+        if not self.signals:
+            return CanarySignal()
+        return signal_delta(self.at_swap, self.signals[-1])
+
+    def consecutive_burning(self) -> tuple:
+        """``(count, objective)`` of the TRAILING run of burning polls —
+        persistence, not a single hot sample."""
+        count = 0
+        objective: Optional[str] = None
+        for burn in reversed(self.burns):
+            if burn is None:
+                break
+            objective = burn
+            count += 1
+        return count, objective
+
+    def describe(self) -> Dict[str, Any]:
+        count, objective = self.consecutive_burning()
+        delta = self.window_delta()
+        return {
+            "samples": self.n_samples,
+            "window_requests": delta.requests_total,
+            "burning_polls": count,
+            "burning_objective": objective,
+            "span_s": (
+                round(self.times[-1] - self.times[0], 3) if self.times else 0.0
+            ),
+        }
+
+
+def judge_canary_window(
+    incumbent: CanarySignal,
+    history: CanaryHistory,
+    config: CanaryConfig,
+) -> CanaryVerdict:
+    """The verdict over a retained history window (the executor's judge
+    since the flight-recorder PR; :func:`judge_canary` remains the
+    single-window primitive it builds on).
+
+    Check order mirrors ``judge_canary`` with two window-strength gates
+    added: (1) traffic below ``min_requests`` is no-signal, as before;
+    (2) an SLO burn must persist for ``burn_polls`` CONSECUTIVE polls to
+    condemn the canary (one hot poll no longer rolls back); (3) fewer
+    than ``min_samples`` observed polls is no-signal — one lucky poll no
+    longer promotes; (4) the goodput/success deltas are computed over
+    the aggregate window, every poll's traffic included."""
+    window = history.window_delta()
+    burn_count, burning = history.consecutive_burning()
+    base = judge_canary(incumbent, window, config, burning_objective=None)
+    metrics = dict(
+        base.metrics,
+        samples=history.n_samples,
+        min_samples=config.min_samples,
+        burning_polls=burn_count,
+        burn_polls_required=config.burn_polls,
+    )
+    if window.requests_total < config.min_requests:
+        return CanaryVerdict(NO_SIGNAL, base.reason, metrics)
+    if burning is not None and burn_count >= config.burn_polls:
+        return CanaryVerdict(
+            ROLLBACK,
+            f"SLO objective {burning!r} fast-burning for {burn_count} "
+            f"consecutive poll(s) (threshold {config.fast_burn_threshold}, "
+            f"required {config.burn_polls})",
+            dict(metrics, burning_objective=burning),
+        )
+    if history.n_samples < config.min_samples:
+        return CanaryVerdict(
+            NO_SIGNAL,
+            f"canary window produced {history.n_samples} sample(s), need "
+            f">= {config.min_samples}: holding (a single poll must not "
+            "promote)",
+            metrics,
+        )
+    return CanaryVerdict(base.decision, base.reason, metrics)

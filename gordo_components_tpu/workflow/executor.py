@@ -42,16 +42,17 @@ import shutil
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from gordo_components_tpu.observability import get_registry
+from gordo_components_tpu.observability import get_event_log, get_registry
 from gordo_components_tpu.workflow.canary import (
     NO_SIGNAL,
     PROMOTE,
     ROLLBACK,
     CanaryConfig,
+    CanaryHistory,
     CanarySignal,
     CanaryVerdict,
     _FP_CANARY,
-    judge_canary,
+    judge_canary_window,
     signal_delta,
     slo_fast_burn,
 )
@@ -696,6 +697,7 @@ class FleetExecutor:
                 landed.append((url, cdir, slice_members, backup))
                 self._land_replica(url, cdir, slice_members, backup)
             at_swap, _ = self._sample_signal(slice_replicas[0][0])
+            history = CanaryHistory(at_swap)
 
             deadline = self._clock() + cfg.window_s
             while True:
@@ -706,22 +708,25 @@ class FleetExecutor:
                 hot = slo_fast_burn(slo_body)
                 if hot is not None and (
                     signal_delta(at_swap, latest).requests_total
-                    >= cfg.min_requests
+                    < cfg.min_requests
                 ):
-                    # a fast burn is an immediate rollback trigger ONLY
-                    # when the canary window itself carried traffic —
-                    # otherwise it is pre-window history (e.g. the burn
-                    # the previous generation caused) and not evidence
-                    # against this canary
-                    burning = hot
+                    # a burn observed before the canary window carried
+                    # traffic is pre-window history (e.g. the burn the
+                    # previous generation caused), not evidence against
+                    # this canary — recorded as not-burning
+                    hot = None
+                history.add(self._clock(), latest, hot)
+                burn_count, burning = history.consecutive_burning()
+                if burn_count >= cfg.burn_polls:
+                    # the burn PERSISTED for the required consecutive
+                    # polls: stop observing early, the window judge
+                    # rolls back on it (one hot poll no longer does)
                     break
                 if self._clock() >= deadline:
                     break
                 self._sleep(min(cfg.poll_s, max(0.0, deadline - self._clock())))
-            verdict = judge_canary(
-                baseline, signal_delta(at_swap, latest), cfg,
-                burning_objective=burning,
-            )
+            verdict = judge_canary_window(baseline, history, cfg)
+            report["canary_window"] = history.describe()
         except Exception as exc:
             # ANY mid-canary failure (including the workflow.canary chaos
             # fault) rolls the slice back to the incumbent before the
@@ -749,6 +754,13 @@ class FleetExecutor:
             report["canary"] = verdict.to_dict()
             if landed:
                 self._counters["verdicts"].labels(ROLLBACK).inc()
+                get_event_log().emit(
+                    "fleet.rollback",
+                    severity="error",
+                    generation=int(state.get("generation", 0)),
+                    reason=verdict.reason,
+                    restore_failures=restore_failures,
+                )
             raise RuntimeError(
                 f"canary failed mid-window"
                 f"{' (rolled back)' if landed else ' (nothing landed)'}: "
@@ -757,11 +769,29 @@ class FleetExecutor:
 
         self._counters["verdicts"].labels(verdict.decision).inc()
         report["canary"] = verdict.to_dict()
+        # satellite of the flight-recorder PR: verdicts are structured
+        # events (process-default log — the executor has no app), so the
+        # watchman's /incidents can attribute a rollback to its burn
+        get_event_log().emit(
+            "canary.verdict",
+            severity="warning" if verdict.decision == ROLLBACK else "info",
+            generation=int(state.get("generation", 0)),
+            decision=verdict.decision,
+            reason=verdict.reason,
+            samples=history.n_samples,
+        )
         if verdict.decision == ROLLBACK:
             restore_failures = self._rollback_landed(landed)
             self._counters["rollbacks"].inc()
             report["rolled_back"] = not restore_failures
             logger.warning("canary ROLLED BACK: %s", verdict.reason)
+            get_event_log().emit(
+                "fleet.rollback",
+                severity="error",
+                generation=int(state.get("generation", 0)),
+                reason=verdict.reason,
+                restore_failures=restore_failures,
+            )
             return {
                 "_status": "failed",
                 "verdict": verdict.to_dict(),
@@ -814,6 +844,12 @@ class FleetExecutor:
         state["promoted_at"] = time.time()
         report["promoted"] = True
         result["generation"] = state["generation"]
+        get_event_log().emit(
+            "fleet.promote",
+            generation=state["generation"],
+            members=len(members),
+            replicas=len(self.replicas),
+        )
         logger.info(
             "fleet generation %d promoted (%d member(s), %d replica(s))",
             state["generation"], len(members), len(self.replicas),

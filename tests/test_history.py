@@ -1,0 +1,724 @@
+"""Fleet flight recorder suite (run via ``make history``): retained
+metric history (observability/timeseries.py), the structured event
+timeline (observability/events.py), watchman incident correlation
+(watchman/correlate.py + ``GET /incidents``), the canary history-window
+judge, and the fleet SLO rollup's last-good staleness contract."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.observability import MetricsRegistry
+from gordo_components_tpu.observability.events import EventLog, set_event_log
+from gordo_components_tpu.observability.timeseries import (
+    HistoryStore,
+    history_from_env,
+    parse_tiers,
+)
+from gordo_components_tpu.replay.clock import ReplayClock
+from gordo_components_tpu.watchman.correlate import (
+    burn_episodes,
+    group_incidents,
+    render_timeline,
+)
+from gordo_components_tpu.workflow.canary import (
+    CanaryConfig,
+    CanaryHistory,
+    CanarySignal,
+    judge_canary_window,
+)
+
+pytestmark = pytest.mark.history
+
+
+# --------------------------------------------------------------------- #
+# tier spec parsing
+# --------------------------------------------------------------------- #
+
+
+def test_parse_tiers_sorts_finest_first():
+    assert parse_tiers("1m@6h,10s@15m") == [(10.0, 900.0), (60.0, 21600.0)]
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "",            # no tiers at all
+        "10s",         # missing retention
+        "10s@5s",      # retention shorter than period
+        "x@15m",       # unparseable period
+        "10s@15m,1m@10m",  # coarser tier retains LESS than the finer one
+    ],
+)
+def test_parse_tiers_rejects_bad_specs(spec):
+    with pytest.raises(ValueError):
+        parse_tiers(spec)
+
+
+# --------------------------------------------------------------------- #
+# HistoryStore: sampling, rates, downsampling, memory bound
+# --------------------------------------------------------------------- #
+
+
+def _store(registry, clock, interval=1.0, tiers=((1.0, 60.0),), max_mb=4.0):
+    return HistoryStore(
+        registry,
+        interval_s=interval,
+        tiers=list(tiers),
+        max_mb=max_mb,
+        clock=clock,
+    )
+
+
+def test_counter_becomes_rate_and_gauge_stays_raw():
+    reg = MetricsRegistry()
+    clock = ReplayClock(1000.0)
+    ctr = reg.counter("reqs_total", "")
+    g = reg.gauge("depth", "")
+    store = _store(reg, clock)
+    g.set(7.0)
+    ctr.inc(0)              # materialize the unlabeled series
+    store.sample()          # first sight of the counter: no rate yet
+    ctr.inc(10)
+    clock.advance(2.0)
+    g.set(9.0)
+    store.sample()          # 10 increments over 2s -> 5/s
+    q = store.query(["reqs_total:rate", "depth"])
+    rate_pts = [p for p in q["reqs_total:rate"]["points"] if p[1] is not None]
+    assert rate_pts == [[1002.0, 5.0]]
+    assert [p[1] for p in q["depth"]["points"]] == [7.0, 9.0]
+
+
+def test_counter_reset_never_yields_negative_rate():
+    """A /reload or restart drops a cumulative counter to ~0 mid-flight:
+    the Prometheus reset rule treats the new cumulative as the whole
+    delta, so the recorded rate is never negative."""
+    reg = MetricsRegistry()
+    clock = ReplayClock(0.0)
+    values = {"v": 0.0}
+    reg.collector(
+        lambda: [("c_total", "counter", "", {}, values["v"])], key="c"
+    )
+    store = _store(reg, clock)
+    for v in (100.0, 200.0, 3.0, 50.0):  # 200 -> 3 is the reset
+        values["v"] = v
+        store.sample()
+        clock.advance(1.0)
+    pts = [
+        p[1]
+        for p in store.query(["c_total:rate"])["c_total:rate"]["points"]
+        if p[1] is not None
+    ]
+    assert pts == [100.0, 3.0, 47.0]
+    assert all(r >= 0 for r in pts)
+
+
+def test_downsampled_tier_averages_within_tolerance():
+    """The coarse tier's slots must equal the mean of the raw samples
+    they cover — downsampling is averaging, not decimation."""
+    reg = MetricsRegistry()
+    clock = ReplayClock(0.0)
+    g = reg.gauge("sig", "")
+    store = _store(reg, clock, interval=1.0, tiers=[(1.0, 30.0), (4.0, 120.0)])
+    raw = []
+    for i in range(16):
+        v = float(10 + (i % 5))
+        g.set(v)
+        raw.append(v)
+        store.sample()
+        clock.advance(1.0)
+    coarse = store.tiers[1]
+    slots = [v for _, v in coarse.points("sig") if v == v]
+    expected = [float(np.mean(raw[i : i + 4])) for i in range(0, 16, 4)]
+    assert slots == pytest.approx(expected, rel=1e-9)
+
+
+def test_memory_bound_is_never_exceeded():
+    """Admission control: a registry with far more series than the
+    budget admits caps at ``max_series`` and counts the drops —
+    ``memory_bytes()`` stays under the configured bound throughout."""
+    reg = MetricsRegistry()
+    clock = ReplayClock(0.0)
+    fam = reg.gauge("wide", "", labelnames=("i",))
+    store = _store(reg, clock, max_mb=0.05, tiers=[(1.0, 600.0)])
+    assert store.max_series > 0
+    for i in range(store.max_series + 50):
+        fam.labels(i=str(i)).set(1.0)
+    for _ in range(3):
+        store.sample()
+        clock.advance(1.0)
+        assert store.memory_bytes() <= store.max_bytes
+    snap = store.snapshot()
+    assert snap["n_series"] == store.max_series
+    assert snap["dropped_series"] > 0
+
+
+def test_query_expands_base_metric_names():
+    """Full series keys contain commas inside label braces, so the
+    comma-separated ``?series=`` form can only carry base names — a
+    labelless request expands to every retained label set."""
+    reg = MetricsRegistry()
+    clock = ReplayClock(0.0)
+    fam = reg.gauge("burn", "", labelnames=("w",))
+    fam.labels(w="5m").set(1.0)
+    fam.labels(w="1h").set(2.0)
+    store = _store(reg, clock)
+    store.sample()
+    q = store.query(["burn"])
+    assert set(q) == {'burn{w="1h"}', 'burn{w="5m"}'}
+    # unknown names still answer (empty), never KeyError
+    assert store.query(["ghost"])["ghost"]["points"] == []
+
+
+def test_query_picks_tier_covering_since():
+    reg = MetricsRegistry()
+    clock = ReplayClock(0.0)
+    g = reg.gauge("sig", "")
+    g.set(1.0)
+    store = _store(reg, clock, interval=1.0, tiers=[(1.0, 10.0), (5.0, 100.0)])
+    for _ in range(40):
+        store.sample()
+        clock.advance(1.0)
+    # recent window -> raw tier; deep window -> only the coarse tier
+    # reaches back that far
+    assert store.query(["sig"], since=clock.time() - 5)["sig"]["tier"] == 0
+    assert store.query(["sig"], since=clock.time() - 35)["sig"]["tier"] == 1
+
+
+def test_history_from_env_default_off(monkeypatch):
+    monkeypatch.delenv("GORDO_HISTORY", raising=False)
+    assert history_from_env(MetricsRegistry()) is None
+    monkeypatch.setenv("GORDO_HISTORY", "1")
+    monkeypatch.setenv("GORDO_HISTORY_INTERVAL_S", "5")
+    store = history_from_env(MetricsRegistry())
+    assert store is not None and store.interval_s == 5.0
+
+
+# --------------------------------------------------------------------- #
+# EventLog
+# --------------------------------------------------------------------- #
+
+
+def test_event_log_ring_drops_oldest_and_counts():
+    log = EventLog(capacity=4, clock=ReplayClock(100.0), replica="r0")
+    for i in range(10):
+        log.emit("tick", i=i)
+    snap = log.snapshot()
+    assert snap["retained"] == 4 and snap["emitted"] == 10
+    assert snap["dropped"] == 6 and snap["by_type"] == {"tick": 10}
+    evs = log.events()
+    assert [e["attrs"]["i"] for e in evs] == [6, 7, 8, 9]
+    assert all(e["replica"] == "r0" for e in evs)
+
+
+def test_event_log_filters_and_limit():
+    clock = ReplayClock(100.0)
+    log = EventLog(capacity=64, clock=clock)
+    log.emit("a")
+    clock.advance(10.0)
+    log.emit("b", severity="error")
+    log.emit("a")
+    assert [e["type"] for e in log.events(types=["a"])] == ["a", "a"]
+    assert [e["type"] for e in log.events(since_wall=105.0)] == ["b", "a"]
+    assert [e["type"] for e in log.events(limit=1)] == ["a"]  # newest kept
+    assert [e["type"] for e in log.events(since_seq=2)] == ["a"]
+    # unknown severity coerces to info rather than raising
+    ev = log.emit("c", severity="shrug")
+    assert ev.severity == "info"
+
+
+def test_event_log_emit_never_raises():
+    log = EventLog(capacity=4)
+    # an unserializable attr payload is retained as-is; a broken clock
+    # degrades to a dropped event, not an exception at the call site
+    class Boom:
+        def time(self):
+            raise RuntimeError("clock down")
+
+        def monotonic(self):
+            raise RuntimeError("clock down")
+
+    broken = EventLog.__new__(EventLog)
+    broken.__init__(capacity=4, clock=Boom())
+    assert broken.emit("x") is None
+    assert log.emit("ok", payload=object()) is not None
+
+
+def test_event_log_thread_safety_under_concurrent_emit():
+    log = EventLog(capacity=10_000)
+    n, threads = 500, 4
+
+    def hammer(tid):
+        for i in range(n):
+            log.emit("t", tid=tid, i=i)
+
+    ts = [threading.Thread(target=hammer, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = log.snapshot()
+    assert snap["emitted"] == n * threads
+    seqs = [e["seq"] for e in log.events()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_process_default_event_log_swappable():
+    from gordo_components_tpu.observability import get_event_log
+
+    mine = EventLog(capacity=8)
+    prev = set_event_log(mine)
+    try:
+        get_event_log().emit("hello")
+        assert mine.snapshot()["by_type"] == {"hello": 1}
+    finally:
+        set_event_log(prev)
+
+
+# --------------------------------------------------------------------- #
+# correlate: episodes -> incidents -> timeline
+# --------------------------------------------------------------------- #
+
+
+def test_burn_episodes_splits_on_gaps_and_threshold():
+    pts = [
+        [0, 0.1], [1, 2.0], [2, 3.0],          # episode 1 (peak 3)
+        [3, 0.2],
+        [4, 5.0], [5, None], [6, 7.0],          # None splits: two runs
+    ]
+    eps = burn_episodes(pts, threshold=1.0)
+    assert [(e["start"], e["end"], e["peak"]) for e in eps] == [
+        (1, 2, 3.0), (4, 4, 5.0), (6, 6, 7.0),
+    ]
+    # min_points drops one-sample blips
+    assert len(burn_episodes(pts, threshold=1.0, min_points=2)) == 1
+    assert burn_episodes([], threshold=1.0) == []
+
+
+def test_group_incidents_merges_within_margin_and_attaches_events():
+    eps = [
+        {"start": 100.0, "end": 110.0, "peak": 3.0, "points": 5,
+         "series": "burn-a", "replica": 0},
+        {"start": 115.0, "end": 120.0, "peak": 9.0, "points": 3,
+         "series": "burn-b", "replica": 1},   # within 30s margin: merged
+        {"start": 400.0, "end": 410.0, "peak": 2.0, "points": 2,
+         "series": "burn-a", "replica": 0},   # far away: own incident
+    ]
+    events = [
+        {"type": "fault.fired", "wall": 95.0, "seq": 1, "severity": "warning"},
+        {"type": "bank.swap", "wall": 118.0, "seq": 2, "severity": "info"},
+        {"type": "unrelated", "wall": 300.0, "seq": 3, "severity": "info"},
+    ]
+    incidents = group_incidents(eps, events, margin_s=30.0)
+    assert len(incidents) == 2
+    first = incidents[0]
+    assert (first["start"], first["end"]) == (100.0, 120.0)
+    assert first["peak_burn"] == 9.0
+    assert first["series"] == ["burn-a", "burn-b"]
+    assert first["replicas"] == [0, 1]
+    assert [e["type"] for e in first["events"]] == ["fault.fired", "bank.swap"]
+    assert "points" not in first["episodes"][0]
+    # the leading event precedes the incident start: negative offset
+    assert first["timeline"][0].lstrip().startswith("-")
+    assert "fault.fired" in first["timeline"][0]
+    assert incidents[1]["events"] == []
+    assert group_incidents([], events) == []
+
+
+def test_render_timeline_orders_and_labels():
+    lines = render_timeline(
+        100.0,
+        [
+            {"type": "a", "wall": 100.5, "severity": "warning",
+             "replica": "replica-1", "attrs": {"k": 1}},
+            {"type": "b", "wall": 103.0, "severity": "info", "attrs": {}},
+        ],
+    )
+    assert "replica-1: a (k=1)" in lines[0] and "[warning]" in lines[0]
+    assert "fleet: b" in lines[1]
+
+
+# --------------------------------------------------------------------- #
+# canary: history-window judging
+# --------------------------------------------------------------------- #
+
+
+def _sig(total, good, wall_good=10.0, wall_total=10.0):
+    return CanarySignal(
+        requests_total=total,
+        requests_goodput=good,
+        wall_goodput_s=wall_good,
+        wall_total_s=wall_total,
+    )
+
+
+INCUMBENT = _sig(1000, 995, 100.0, 101.0)
+
+
+def test_window_judge_single_poll_must_not_promote():
+    cfg = CanaryConfig(min_samples=3, burn_polls=2)
+    hist = CanaryHistory(_sig(0, 0, 0, 0))
+    hist.add(1.0, _sig(100, 100))
+    verdict = judge_canary_window(INCUMBENT, hist, cfg)
+    assert verdict.decision == "no_signal"
+    assert "single poll" in verdict.reason
+    assert verdict.metrics["samples"] == 1
+
+
+def test_window_judge_promotes_on_full_healthy_window():
+    cfg = CanaryConfig(min_samples=3, burn_polls=2)
+    hist = CanaryHistory(_sig(0, 0, 0, 0))
+    for i in range(1, 4):
+        hist.add(float(i), _sig(100 * i, 100 * i, 10.0 * i, 10.0 * i))
+    verdict = judge_canary_window(INCUMBENT, hist, cfg)
+    assert verdict.decision == "promote"
+    # the judged delta spans the WHOLE window, not the last poll
+    assert verdict.metrics["canary_requests"] == 300.0
+
+
+def test_window_judge_one_hot_poll_does_not_roll_back():
+    """A single fast-burning /slo poll inside an otherwise healthy
+    window holds (burn must persist for ``burn_polls``); persistence
+    rolls back with the fast-burning reason the live tests pin."""
+    cfg = CanaryConfig(min_samples=2, burn_polls=2)
+    hist = CanaryHistory(_sig(0, 0, 0, 0))
+    hist.add(1.0, _sig(100, 100), burning_objective="availability")
+    hist.add(2.0, _sig(200, 200), burning_objective=None)
+    hist.add(3.0, _sig(300, 300), burning_objective=None)
+    assert judge_canary_window(INCUMBENT, hist, cfg).decision == "promote"
+
+    hot = CanaryHistory(_sig(0, 0, 0, 0))
+    hot.add(1.0, _sig(100, 100), burning_objective=None)
+    hot.add(2.0, _sig(200, 180), burning_objective="availability")
+    hot.add(3.0, _sig(300, 260), burning_objective="availability")
+    verdict = judge_canary_window(INCUMBENT, hot, cfg)
+    assert verdict.decision == "rollback"
+    assert "fast-burning" in verdict.reason
+    assert verdict.metrics["burning_objective"] == "availability"
+    assert verdict.metrics["burning_polls"] == 2
+
+
+def test_window_judge_no_traffic_is_no_signal():
+    cfg = CanaryConfig(min_requests=10, min_samples=1)
+    hist = CanaryHistory(_sig(0, 0, 0, 0))
+    hist.add(1.0, _sig(2, 2))
+    assert judge_canary_window(INCUMBENT, hist, cfg).decision == "no_signal"
+
+
+def test_canary_config_rejects_degenerate_window_knobs():
+    with pytest.raises(ValueError):
+        CanaryConfig.from_spec({"min_samples": 0}, use_env=False)
+    with pytest.raises(ValueError):
+        CanaryConfig.from_spec({"burn_polls": 0}, use_env=False)
+    cfg = CanaryConfig.from_spec(
+        {"min_samples": 5, "burn_polls": 3}, use_env=False
+    )
+    assert cfg.describe()["min_samples"] == 5
+    assert cfg.describe()["burn_polls"] == 3
+
+
+# --------------------------------------------------------------------- #
+# server endpoints + the fleet rollups (live app)
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def collection_dir(tmp_path_factory):
+    from gordo_components_tpu import serializer
+    from gordo_components_tpu.models import (
+        AutoEncoder,
+        DiffBasedAnomalyDetector,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 3).astype("float32")
+    root = tmp_path_factory.mktemp("history-collection")
+    for name in ("m-1", "m-2"):
+        det = DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(epochs=1, batch_size=64)
+        )
+        det.fit(X)
+        serializer.dump(det, str(root / name), metadata={"name": name})
+    return str(root)
+
+
+async def _app_client(model_dir):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu.server import build_app
+
+    app = build_app(model_dir)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return app, client
+
+
+async def test_history_endpoint_disabled_by_default(
+    collection_dir, monkeypatch
+):
+    monkeypatch.delenv("GORDO_HISTORY", raising=False)
+    app, client = await _app_client(collection_dir)
+    try:
+        assert app["history"] is None  # near-free when off: one None key
+        body = await (await client.get("/gordo/v0/t/history")).json()
+        assert body == {"enabled": False}
+    finally:
+        await client.close()
+
+
+async def test_history_and_events_endpoints_live(collection_dir, monkeypatch):
+    monkeypatch.setenv("GORDO_HISTORY", "1")
+    monkeypatch.setenv("GORDO_HISTORY_INTERVAL_S", "0.1")
+    monkeypatch.setenv("GORDO_HISTORY_TIERS", "0.1s@5m")
+    app, client = await _app_client(collection_dir)
+    try:
+        rng = np.random.RandomState(1)
+        for _ in range(4):
+            resp = await client.post(
+                "/gordo/v0/t/m-1/anomaly/prediction",
+                json={"X": rng.rand(16, 3).tolist()},
+            )
+            assert resp.status == 200
+        import asyncio
+
+        await asyncio.sleep(0.35)  # a few background sampler ticks
+        meta = await (await client.get("/gordo/v0/t/history")).json()
+        assert meta["enabled"] and meta["samples"] >= 2
+        assert any(
+            n.startswith("gordo_server_requests_total") for n in meta["names"]
+        )
+        q = await (
+            await client.get(
+                "/gordo/v0/t/history",
+                params={"series": "gordo_server_requests_total"},
+            )
+        ).json()
+        assert q["series"], q
+        # a /reload lands bank.swap + models.reload on the timeline
+        assert (await client.post("/gordo/v0/t/reload")).status == 200
+        events = await (await client.get("/gordo/v0/t/events")).json()
+        types = {e["type"] for e in events["events"]}
+        assert {"bank.swap", "models.reload"} <= types
+        assert events["by_type"]["bank.swap"] >= 1
+        only = await (
+            await client.get(
+                "/gordo/v0/t/events", params={"type": "bank.swap", "limit": "1"}
+            )
+        ).json()
+        assert [e["type"] for e in only["events"]] == ["bank.swap"]
+        gen = app["bank_generation"]
+        assert any(
+            e["type"] == "bank.swap" and e["generation"] == gen
+            for e in events["events"]
+        )
+    finally:
+        await client.close()
+
+
+async def test_fleet_slo_serves_last_good_with_staleness(collection_dir):
+    """Satellite regression: an unreachable replica's last-good /slo
+    body keeps contributing to the fleet merge, stamped stale +
+    stale_seconds — it must not silently vanish (its budget is still
+    burning), and replicas_scraped counts only LIVE scrapes."""
+    from aiohttp.test_utils import TestServer
+
+    from gordo_components_tpu.server import build_app
+    from gordo_components_tpu.watchman.server import WatchmanState
+
+    server = TestServer(build_app(collection_dir))
+    await server.start_server()
+    base = f"http://{server.host}:{server.port}"
+    state = WatchmanState(
+        "t", base, metrics_urls=[f"{base}/gordo/v0/t/metrics"]
+    )
+    try:
+        first = await state.fleet_slo(refresh=True)
+        assert first["replicas_scraped"] == 1
+        rep = first["replicas"][0]
+        assert rep["scraped"] is True and rep["stale"] is False
+    finally:
+        await server.close()
+    second = await state.fleet_slo(refresh=True)
+    rep = second["replicas"][0]
+    assert second["replicas_scraped"] == 0
+    assert rep["scraped"] is False
+    assert rep["stale"] is True
+    assert rep["stale_seconds"] is not None and rep["stale_seconds"] >= 0
+    # the last-good body still contributes the merged burn state
+    assert rep["worst"] == first["replicas"][0]["worst"]
+
+
+@pytest.mark.slow
+async def test_gameday_incident_detected_with_ordered_timeline(
+    collection_dir, monkeypatch
+):
+    """The acceptance game-day: a latency/error fault under scoring load
+    burns the SLO budget and trips the quarantine; recovery reloads the
+    bank. The watchman's ``/incidents`` must detect ONE incident whose
+    timeline carries the fault, quarantine, and recovery events in
+    order."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu import resilience
+    from gordo_components_tpu.server import build_app
+    from gordo_components_tpu.watchman.server import (
+        WatchmanState,
+        build_watchman_app,
+    )
+
+    monkeypatch.setenv("GORDO_HISTORY", "1")
+    monkeypatch.setenv("GORDO_HISTORY_INTERVAL_S", "0.1")
+    monkeypatch.setenv("GORDO_HISTORY_TIERS", "0.1s@5m")
+    monkeypatch.setenv("GORDO_SLO_SAMPLE_S", "0.1")
+    server = TestServer(build_app(collection_dir))
+    await server.start_server()
+    base = f"http://{server.host}:{server.port}"
+    rng = np.random.RandomState(2)
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+
+            async def score(name, deadline_ms=None):
+                headers = (
+                    {"X-Gordo-Deadline-Ms": str(deadline_ms)}
+                    if deadline_ms
+                    else {}
+                )
+                async with session.post(
+                    f"{base}/gordo/v0/t/{name}/anomaly/prediction",
+                    json={"X": rng.rand(16, 3).tolist()},
+                    headers=headers,
+                ) as resp:
+                    return resp.status
+
+            for _ in range(6):  # healthy baseline
+                assert await score("m-1") == 200
+            await asyncio.sleep(0.3)
+
+            # the fault: scoring errors trip m-2's quarantine, and a
+            # queue stall vs tight deadlines produces 5xx budget burn
+            resilience.arm(
+                "bank.score", times=12, exc=resilience.FaultInjected
+            )
+            resilience.arm("engine.queue", delay_s=0.05, exc=None)
+            statuses = []
+            for i in range(22):
+                if i < 8:
+                    statuses.append(await score("m-2"))
+                else:
+                    statuses.append(await score("m-1", deadline_ms=10))
+                await asyncio.sleep(0.04)
+            assert 504 in statuses  # the burn actually happened
+            resilience.reset()
+
+            async with session.post(f"{base}/gordo/v0/t/reload") as resp:
+                assert resp.status == 200
+            await asyncio.sleep(0.3)  # post-recovery sampler ticks
+
+        state = WatchmanState(
+            "t", base, metrics_urls=[f"{base}/gordo/v0/t/metrics"]
+        )
+        report = await state.fleet_incidents(threshold=1.0, margin_s=10.0)
+        assert report["detected"] >= 1, report
+        assert report["replicas_with_history"] == 1
+        incident = report["incidents"][0]
+        assert incident["peak_burn"] >= 1.0
+        assert any("availability" in s for s in incident["series"])
+        types_in_order = [e["type"] for e in incident["events"]]
+        assert "fault.fired" in types_in_order
+        assert "quarantine.enter" in types_in_order
+        assert "models.reload" in types_in_order
+        # causality reads left to right: the fault precedes the
+        # quarantine trip, which precedes the recovery reload
+        assert types_in_order.index("fault.fired") < types_in_order.index(
+            "quarantine.enter"
+        )
+        assert types_in_order.index(
+            "quarantine.enter"
+        ) < types_in_order.index("models.reload")
+        walls = [e["wall"] for e in incident["events"]]
+        assert walls == sorted(walls)
+        assert len(incident["timeline"]) == len(incident["events"])
+
+        # and the same correlation serves over the watchman's HTTP API
+        wapp = build_watchman_app(
+            "t", base, metrics_urls=[f"{base}/gordo/v0/t/metrics"]
+        )
+        wclient = TestClient(TestServer(wapp))
+        await wclient.start_server()
+        try:
+            body = await (
+                await wclient.get(
+                    "/incidents", params={"threshold": "1.0", "margin": "10"}
+                )
+            ).json()
+            assert body["detected"] >= 1
+            fleet_events = await (await wclient.get("/events")).json()
+            assert any(
+                e["type"] == "quarantine.enter"
+                for e in fleet_events["events"]
+            )
+            hist = await (
+                await wclient.get(
+                    "/history", params={"series": "gordo_slo_burn_rate"}
+                )
+            ).json()
+            assert hist["replicas_scraped"] == 1
+            assert hist["replicas"][0]["series"]
+        finally:
+            await wclient.close()
+    finally:
+        resilience.reset()
+        await server.close()
+
+
+# --------------------------------------------------------------------- #
+# hot-loop overhead guard (CI lanes: make history / make hotloop)
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.hotloop
+def test_sampler_overhead_on_hot_path_within_5pct():
+    """The background sampler contends with hot-path ``inc()`` only on
+    the registry's per-family locks. Hammering counters with a sampler
+    thread snapshotting at full tilt must stay within 5% of the same
+    hammer uncontended — interleaved best-of-N so machine drift hits
+    both sides."""
+    reg = MetricsRegistry()
+    ctr = reg.counter("hot_total", "", labelnames=("k",)).labels(k="a")
+    store = HistoryStore(
+        reg, interval_s=0.001, tiers=[(0.001, 1.0)], max_mb=4.0
+    )
+
+    def hammer(iters=60_000):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ctr.inc()
+        return time.perf_counter() - t0
+
+    hammer(5_000)  # warm
+    stop = threading.Event()
+
+    def sample_loop():
+        while not stop.is_set():
+            store.sample()
+
+    ratios = []
+    for _ in range(5):
+        base = hammer()
+        stop.clear()
+        t = threading.Thread(target=sample_loop)
+        t.start()
+        try:
+            contended = hammer()
+        finally:
+            stop.set()
+            t.join()
+        ratios.append(contended / base)
+    assert min(ratios) <= 1.05, ratios
+    assert store.samples_taken > 0
