@@ -6,10 +6,12 @@ from gordo_components_tpu.client.forwarders import (
     ForwardPredictionsIntoInflux,
     ForwardPredictionsIntoParquet,
 )
+from gordo_components_tpu.client.subscribe import PushSubscriber
 
 __all__ = [
     "Client",
     "PredictionResult",
     "ForwardPredictionsIntoInflux",
     "ForwardPredictionsIntoParquet",
+    "PushSubscriber",
 ]

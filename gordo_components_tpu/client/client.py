@@ -16,6 +16,7 @@ import itertools
 import json
 import logging
 import random
+import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
@@ -120,6 +121,7 @@ class Client:
         hedge_delay_init_s: float = 1.0,
         routing_url: Optional[str] = None,
         routing: Optional[Dict[str, Any]] = None,
+        routing_refresh_window_s: float = 5.0,
     ):
         self.project = project
         # normalized (no trailing slash) so the hedge target exclusion
@@ -172,7 +174,16 @@ class Client:
             self._install_routing(routing)
         self._fanout_stats: Dict[str, int] = {
             "routed_chunks": 0, "routing_refreshes": 0, "reroutes": 0,
+            "refreshes_throttled": 0,
         }
+        # stale-table forced-refresh rate limit: ONE forced /routing
+        # refetch per member per window. During a migration storm with a
+        # dead replica, every chunk of every displaced member would
+        # otherwise force its own refresh — a refresh stampede against
+        # watchman exactly when it is busiest. Throttled attempts keep
+        # their 404 (the bounded-retry contract is per-window, not gone).
+        self.routing_refresh_window_s = float(routing_refresh_window_s)
+        self._forced_refresh_at: Dict[str, float] = {}
         # request-body encoding for scoring POSTs: "auto" upgrades to
         # parquet when the server advertises it (JSON float-list
         # encode/decode dominates at fleet-backfill scale — the reference's
@@ -313,6 +324,12 @@ class Client:
                 "Chunks re-posted after a stale-table 404 forced a "
                 "routing refresh", labels, c._fanout_stats["reroutes"],
             )
+            yield (
+                "gordo_client_routing_refreshes_throttled_total", "counter",
+                "Forced stale-table refreshes suppressed by the "
+                "per-member rate limit (refresh-stampede guard)",
+                labels, c._fanout_stats["refreshes_throttled"],
+            )
             for enc, st in list(c._wire_stats.items()):
                 yield (
                     "gordo_client_request_bytes_total", "counter",
@@ -393,14 +410,34 @@ class Client:
     def routing_version(self) -> Optional[int]:
         return self._routing["version"] if self._routing else None
 
-    async def _fetch_routing(self, session, force: bool = False) -> bool:
+    async def _fetch_routing(
+        self, session, force: bool = False, member: Optional[str] = None
+    ) -> bool:
         """Fetch/refresh the routing table from watchman. ETag-
         conditional: an unchanged table costs a 304 and keeps the local
         index. Returns True when the local table CHANGED. Best-effort —
         a watchman outage downgrades the run to single-URL posting (the
-        configured base_url) rather than failing it."""
+        configured base_url) rather than failing it.
+
+        ``member`` (stale-table callers only) engages the per-member
+        forced-refresh rate limit: at most one forced refetch per member
+        per ``routing_refresh_window_s``; throttled calls return False
+        without touching the network and count
+        ``gordo_client_routing_refreshes_throttled_total``."""
         if self.routing_url is None:
             return False
+        if force and member is not None:
+            now = time.monotonic()
+            last = self._forced_refresh_at.get(member)
+            if (
+                last is not None
+                and now - last < self.routing_refresh_window_s
+            ):
+                self._fanout_stats["refreshes_throttled"] += 1
+                return False
+            # stamped BEFORE the attempt: a watchman that is down (the
+            # storm case) must not be hammered by failed-refresh retries
+            self._forced_refresh_at[member] = now
         headers = {}
         if self._routing_etag and not force:
             headers["If-None-Match"] = self._routing_etag
@@ -742,7 +779,7 @@ class Client:
             # one forced refetch, one retry against the new owner
             if self._routing is None or "404" not in str(exc):
                 raise
-            if not await self._fetch_routing(session, force=True):
+            if not await self._fetch_routing(session, force=True, member=target):
                 raise
             logger.warning(
                 "routing table was stale (now v%s); refetching metadata "
@@ -1388,7 +1425,7 @@ class Client:
             # every failed chunk to the new owner — one bounded retry,
             # not a loop (an unchanged table means the member truly has
             # no owner, and the 404-with-reason stands as the answer)
-            if await self._fetch_routing(session, force=True):
+            if await self._fetch_routing(session, force=True, member=target):
                 retry = [i for i, b in enumerate(bodies) if b is None]
                 self._fanout_stats["reroutes"] += len(retry)
                 logger.warning(

@@ -1,0 +1,32 @@
+"""Child-process entry for game-day replicas.
+
+``python -m gordo_components_tpu.gameday.replica --root DIR --port N``
+boots ONE real serving replica over the shared artifact dir — the same
+process shape production runs and ``tools/mesh_demo.py`` measures. The
+interesting configuration all rides the environment the harness sets
+before spawning: mesh identity (``GORDO_MESH_REPLICA_ID`` /
+``GORDO_MESH_REPLICAS``), the streaming/push planes (``GORDO_STREAM``,
+``GORDO_PUSH``), observability cadence, and — the point of this package
+— ``GORDO_FAULTS``, which ``server.build_app`` arms at boot, so a fault
+injected by the parent is live inside a process boundary away.
+"""
+
+import argparse
+import os
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", required=True, help="shared artifact dir")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+
+    from gordo_components_tpu.server import run_server
+
+    run_server(args.root, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ import pandas as pd
 
 from gordo_components_tpu.replay.clock import ReplayClock
 from gordo_components_tpu.replay.incidents import Scenario, combine_injection
+from gordo_components_tpu.replay.verdict import finalize_verdict
 from gordo_components_tpu.utils.wire import TENSOR_CONTENT_TYPE, pack_frames
 
 logger = logging.getLogger(__name__)
@@ -550,9 +551,7 @@ class ReplayEngine:
                 )
             faults.reset()
             await client.close()
-        verdict["failures"] = scenario.judge(verdict)
-        verdict["passed"] = not verdict["failures"]
-        return verdict
+        return finalize_verdict(verdict, scenario.judge(verdict))
 
     # ------------------------------------------------------------------ #
     # metric surface (per-run app registry, read-through)

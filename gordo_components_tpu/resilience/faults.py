@@ -34,8 +34,21 @@ reading ``GORDO_FAULTS``), with three modes composable per spec:
 
 ``kind`` is ``error`` (arg: exception class name, default
 :class:`FaultInjected`) or ``latency`` (arg: seconds, raises nothing
-unless ``error=Name`` is added). Unknown sites are accepted — arming may
-precede the importing of the module that registers the site.
+unless ``error=Name`` is added), plus three transport-level kinds the
+mesh game days drive over subprocess boundaries (a site placed on a
+connection-handling path — e.g. ``server.connection`` — turns these
+into real socket-level failures):
+
+- ``refuse`` — raises :class:`ConnectionRefusedError` (the peer's port
+  answers RST: process down, nothing listening);
+- ``reset`` — raises :class:`ConnectionResetError` (the connection died
+  mid-exchange: crash after accept, middlebox cut);
+- ``blackhole[:seconds]`` — sleeps (default 5s: packets silently
+  dropped, the caller hangs until its own deadline) then raises
+  :class:`TimeoutError`.
+
+Unknown sites are accepted — arming may precede the importing of the
+module that registers the site.
 """
 
 import logging
@@ -286,9 +299,29 @@ def _parse_clause(clause: str) -> tuple:
     elif kind == "latency":
         kwargs["delay_s"] = float(arg or 0.01)
         kwargs["exc"] = None
+    elif kind == "refuse":
+        if arg:
+            raise ValueError(
+                f"fault kind 'refuse' takes no argument (got {arg!r} in "
+                f"{clause!r})"
+            )
+        kwargs["exc"] = ConnectionRefusedError
+    elif kind == "reset":
+        if arg:
+            raise ValueError(
+                f"fault kind 'reset' takes no argument (got {arg!r} in "
+                f"{clause!r})"
+            )
+        kwargs["exc"] = ConnectionResetError
+    elif kind == "blackhole":
+        # a blackhole HANGS the caller (dropped packets, no RST) before
+        # surfacing as a timeout — delay first, TimeoutError after
+        kwargs["delay_s"] = float(arg or 5.0)
+        kwargs["exc"] = TimeoutError
     else:
         raise ValueError(
-            f"unknown fault kind {kind!r} in {clause!r} (error|latency)"
+            f"unknown fault kind {kind!r} in {clause!r} "
+            "(error|latency|blackhole|refuse|reset)"
         )
     for opt in opts:
         k, _, v = opt.partition("=")

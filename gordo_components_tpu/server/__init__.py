@@ -22,7 +22,11 @@ from gordo_components_tpu.observability import MetricsRegistry, Tracer
 from gordo_components_tpu.observability.goodput import GoodputLedger
 from gordo_components_tpu.observability.slo import SLOTracker
 from gordo_components_tpu.observability.tracing import format_traceparent
-from gordo_components_tpu.resilience import QuarantineSet, configure_from_env
+from gordo_components_tpu.resilience import (
+    QuarantineSet,
+    configure_from_env,
+    faultpoint,
+)
 from gordo_components_tpu.resilience.deadline import (
     DEADLINE_HEADER,
     Deadline,
@@ -50,6 +54,32 @@ CLIENT_MAX_SIZE = 256 * 1024**2
 # and lock-free. The worker pool (server/workers.py) installs a real
 # threading.Lock so N worker loops can't lose counter increments.
 _NO_LOCK = contextlib.nullcontext()
+
+
+# transport-level chaos seam (mesh game days): armed with the
+# connection-class fault kinds (refuse/reset/blackhole — resilience/
+# faults.py), the middleware below ABORTS the raw socket instead of
+# answering, so a real peer observes a real transport failure
+# (ServerDisconnectedError / hang), not an in-band 500. Injection over
+# subprocess boundaries rides GORDO_FAULTS, which build_app arms.
+_FP_CONNECTION = faultpoint("server.connection")
+
+
+@web.middleware
+async def _chaos_transport_middleware(request, handler):
+    """Outermost middleware: when ``server.connection`` fires, kill the
+    TCP connection before any handler (or stats accounting) runs — the
+    disarmed cost is one attribute read per request."""
+    try:
+        _FP_CONNECTION.fire()
+    except asyncio.CancelledError:
+        raise
+    except BaseException:
+        transport = request.transport
+        if transport is not None:
+            transport.abort()
+        raise
+    return await handler(request)
 
 
 def _trace_headers(headers, rid: str, trace) -> None:
@@ -465,7 +495,8 @@ def build_app(
         if want > 1:
             mesh = fleet_mesh(want)
     app = web.Application(
-        client_max_size=CLIENT_MAX_SIZE, middlewares=[_stats_middleware]
+        client_max_size=CLIENT_MAX_SIZE,
+        middlewares=[_chaos_transport_middleware, _stats_middleware],
     )
     # the wall-time seam: every component whose semantics are defined in
     # wall time (streaming lateness/staleness, SLO windows) reads THIS

@@ -48,6 +48,12 @@ logger = logging.getLogger(__name__)
 # worthless exactly when it matters
 _FP_SCRAPE = faultpoint("watchman.scrape")
 _FP_SNAPSHOT = faultpoint("watchman.snapshot")
+# the watchman<->replica network seam: fires once per replica probe in
+# the routing rebuild, so the transport fault kinds (reset/refuse/
+# blackhole — resilience/faults.py) partition watchman from the fleet
+# without touching the replicas. A fired probe reads as "replica
+# unreachable" — exactly what a real partition looks like from here.
+_FP_PROBE = faultpoint("watchman.probe")
 
 
 def aggregate_fleet_metrics(
@@ -328,6 +334,12 @@ class WatchmanState:
         # releases (the zero-404 ordering); dropped once observation
         # confirms single ownership at the destination
         self._routing_overrides: Dict[str, int] = {}
+        # last observed reachability per replica index: a True->False
+        # transition (replica went dark) FORCES a version bump and emits
+        # mesh.replica_unreachable, so partition-aware clients poll their
+        # way off dead owners even if the table content were to compare
+        # equal (and the incident timeline gets the causal edge)
+        self._replica_reachable: Dict[int, bool] = {}
         # per-replica full member lists from the last routing refresh
         # (fleet-planner input; deliberately NOT in the GET /routing body
         # — the members map already carries the full assignment once)
@@ -1031,6 +1043,17 @@ class WatchmanState:
             async with aiohttp.ClientSession(timeout=timeout) as session:
 
                 async def probe(i: int, prefix: str):
+                    try:
+                        _FP_PROBE.fire()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:
+                        # an injected partition: this replica is dark
+                        # from watchman's side of the network this round
+                        logger.debug(
+                            "routing probe chaos for %s: %s", prefix, exc
+                        )
+                        return i, None, None
                     models, health = await asyncio.gather(
                         self._get_json(session, prefix + "/models"),
                         self._get_json(session, prefix + "/healthz"),
@@ -1103,10 +1126,45 @@ class WatchmanState:
             for name in list(self._routing_overrides):
                 if name not in observed:
                     del self._routing_overrides[name]
+            # reachability transitions: a replica going dark is a routing
+            # event in its own right — the version MUST step (clients
+            # ETag-poll off the dead owner) and the fleet timeline gets
+            # the edge the incident correlator orders against SLO burn
+            went_dark: List[Dict[str, Any]] = []
+            came_back: List[Dict[str, Any]] = []
+            for rep in replicas:
+                prev = self._replica_reachable.get(rep["replica"])
+                if prev is True and not rep["reachable"]:
+                    went_dark.append(rep)
+                elif prev is False and rep["reachable"]:
+                    came_back.append(rep)
+                self._replica_reachable[rep["replica"]] = rep["reachable"]
             core = self._routing_content_key(members, replicas, migrating)
             if core != self._routing_core:
                 self._routing_version += 1
                 self._routing_core = core
+            elif went_dark:
+                # belt-and-braces: the content key already covers the
+                # reachable flag, but the unreachable transition is the
+                # one case where serving a stale version means routing
+                # scoring traffic at a corpse — bump unconditionally
+                self._routing_version += 1
+            for rep in went_dark:
+                self.events.emit(
+                    "mesh.replica_unreachable",
+                    severity="error",
+                    replica_index=rep["replica"],
+                    url=rep["url"],
+                    routing_version=self._routing_version,
+                )
+            for rep in came_back:
+                self.events.emit(
+                    "mesh.replica_recovered",
+                    severity="info",
+                    replica_index=rep["replica"],
+                    url=rep["url"],
+                    routing_version=self._routing_version,
+                )
             self._routing_member_lists = member_lists
             self._routing_cache = {
                 "project": self.project,

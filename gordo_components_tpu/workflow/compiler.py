@@ -7,7 +7,7 @@ optional ``fleet:`` section declaring canary policy, SLO objectives,
 refit schedules, and replica targets — into a :class:`FleetDAG` of
 
     build/<machine> -> bucket/<gang> -> place/fleet -> canary/fleet
-                                                    -> promote/fleet
+                                     [-> gameday/fleet] -> promote/fleet
 
 steps with content-digest keys (workflow/dag.py). The DAG is the
 reviewed artifact: ~ten env knobs (canary window, burn threshold,
@@ -37,6 +37,12 @@ under ``fleet:`` is optional with validated defaults)::
         objectives: [{name: availability, target: 0.999}, ...]
       schedules:
         refit_every: 6h        # re-enter the DAG on this cadence
+      gameday:
+        gate: [replica_crash_restart, gray_failure_slow_replica]
+        # pre-promotion game-day drills (gameday/gate.py) run between
+        # canary and promote; a failed drill blocks promote. Names must
+        # be gate-capable scenarios from the gameday catalog — validated
+        # at compile time.
 
 Unknown keys under ``fleet:`` raise at compile time — a typo'd rollout
 policy must fail in review, not deploy a default silently (the same
@@ -59,8 +65,10 @@ _FLEET_KEYS = {
     "canary",
     "slo",
     "schedules",
+    "gameday",
 }
 _SCHEDULE_KEYS = {"refit_every"}
+_GAMEDAY_KEYS = {"gate"}
 
 
 class FleetSpec:
@@ -155,6 +163,47 @@ class FleetSpec:
             ((_, seconds),) = parse_windows(str(schedules["refit_every"]))
             self.refit_every_s = seconds
 
+        # pre-promotion game-day gate (gameday/gate.py): the declared
+        # scenarios become a 'gameday' step between canary and promote.
+        # Validated against the scenario catalog at COMPILE time — a
+        # typo'd or non-gate-capable scenario must fail in review, not
+        # skip a declared drill at rollout time
+        gameday = raw.get("gameday") or {}
+        if not isinstance(gameday, dict) or set(gameday) - _GAMEDAY_KEYS:
+            raise ValueError(
+                f"fleet.gameday keys must be a subset of {sorted(_GAMEDAY_KEYS)}"
+            )
+        self.gameday_gate: Optional[List[str]] = None
+        if "gate" in gameday:
+            gate = gameday["gate"]
+            if not (
+                isinstance(gate, list)
+                and gate
+                and all(isinstance(s, str) for s in gate)
+            ):
+                raise ValueError(
+                    "fleet.gameday.gate must be a non-empty list of "
+                    f"scenario names, got {gate!r}"
+                )
+            from gordo_components_tpu.gameday.scenarios import SCENARIOS
+
+            unknown_sc = sorted(set(gate) - set(SCENARIOS))
+            if unknown_sc:
+                raise ValueError(
+                    f"unknown gameday scenario(s) {unknown_sc} "
+                    f"(known: {sorted(SCENARIOS)})"
+                )
+            not_capable = sorted(
+                s for s in gate if not SCENARIOS[s].gate_capable
+            )
+            if not_capable:
+                raise ValueError(
+                    f"gameday scenario(s) {not_capable} have no gate-mode "
+                    "drill (gate-capable: "
+                    f"{sorted(n for n, s in SCENARIOS.items() if s.gate_capable)})"
+                )
+            self.gameday_gate = list(gate)
+
     def describe(self) -> Dict[str, Any]:
         """The policy block embedded in the DAG meta (and therefore in
         the golden JSON): everything that ISN'T per-step payload."""
@@ -178,6 +227,8 @@ class FleetSpec:
             out["slo_windows"] = self.slo_windows
         if self.refit_every_s is not None:
             out["refit_every_s"] = self.refit_every_s
+        if self.gameday_gate is not None:
+            out["gameday_gate"] = list(self.gameday_gate)
         return out
 
 
@@ -310,12 +361,32 @@ def compile_fleet(
             payload=canary_payload,
         )
     )
+    # optional pre-promotion game-day gate: canary -> gameday -> promote.
+    # Its key chains the canary's (a new generation re-drills) plus the
+    # declared scenario list (editing the drill set re-drills); promote's
+    # key chains the gate's, so a gate edit also re-promotes
+    promote_deps: List[str] = ["canary/fleet"]
+    promote_key_deps: List[str] = [canary_key]
+    if spec.gameday_gate:
+        gate_payload = {"scenarios": list(spec.gameday_gate)}
+        gate_key = content_key(gate_payload, deps=(canary_key,))
+        steps.append(
+            Step(
+                step_id="gameday/fleet",
+                kind="gameday",
+                key=gate_key,
+                deps=("canary/fleet",),
+                payload=gate_payload,
+            )
+        )
+        promote_deps.append("gameday/fleet")
+        promote_key_deps.append(gate_key)
     steps.append(
         Step(
             step_id="promote/fleet",
             kind="promote",
-            key=content_key({}, deps=(canary_key,)),
-            deps=("canary/fleet",),
+            key=content_key({}, deps=promote_key_deps),
+            deps=tuple(promote_deps),
             payload={},
         )
     )

@@ -39,7 +39,7 @@ SCHEMA = "gordo.fleet-dag/v1"
 
 # execution phases in dependency order; used only as a deterministic
 # tiebreak in topological ordering (edges are the real constraint)
-KINDS = ("build", "bucket", "place", "canary", "promote")
+KINDS = ("build", "bucket", "place", "canary", "gameday", "promote")
 _KIND_ORDER = {k: i for i, k in enumerate(KINDS)}
 
 

@@ -808,6 +808,57 @@ class FleetExecutor:
             "backup": backup_root,
         }
 
+    def _exec_gameday(self, step, state, report, built_this_run) -> Dict[str, Any]:
+        """Pre-promotion game-day gate (gameday/gate.py): run the
+        spec's declared gate-mode drills against the canary replica
+        that just served its window. A failed drill fails the step,
+        which blocks promote through ordinary dep propagation — the
+        same containment shape as a canary rollback, minus the
+        rollback (the slice stays landed for triage; the next run
+        re-drills because ``failed`` is not cacheable)."""
+        from gordo_components_tpu.gameday.gate import run_promotion_gate
+
+        scenario_names = step.payload.get("scenarios")
+        if not self.replicas:
+            return {
+                "_status": "planned",
+                "mode": "plan_only",
+                "scenarios": list(scenario_names or []),
+            }
+        base_url = self.replicas[0][0]
+        doc = run_promotion_gate(
+            base_url,
+            self.project,
+            scenarios=scenario_names,
+            traffic=self.traffic_hook,
+            http_timeout=self.http_timeout,
+        )
+        report["gameday_gate"] = doc
+        failures = [
+            f"{name}: {f}"
+            for name, v in doc["scenarios"].items()
+            for f in v.get("failures", [])
+        ]
+        get_event_log().emit(
+            "gameday.gate",
+            severity="error" if failures else "info",
+            generation=int(state.get("generation", 0)),
+            scenarios=sorted(doc["scenarios"]),
+            passed=bool(doc["passed"]),
+            failures=failures,
+        )
+        if not doc["passed"]:
+            logger.warning(
+                "gameday gate BLOCKED promotion of %s: %s",
+                base_url, "; ".join(failures),
+            )
+            return {"_status": "failed", "gate": doc, "failures": failures}
+        logger.info(
+            "gameday gate passed on %s (%s)",
+            base_url, ", ".join(sorted(doc["scenarios"])),
+        )
+        return {"gate": doc}
+
     def _exec_promote(self, step, state, report, built_this_run) -> Dict[str, Any]:
         members = self._members_for_rollout(state)
         result: Dict[str, Any] = {}

@@ -41,6 +41,8 @@ EXPECTED_SITES = {
     "model_io.load",
     "stream.ingest",  # driven in tests/test_streaming.py (chaos mark)
     "stream.refit",  # driven in tests/test_streaming.py (chaos mark)
+    "server.connection",  # transport aborts; driven in the gameday drills
+    "watchman.probe",  # watchman<->replica partition (gameday drills)
     "watchman.scrape",
     "watchman.snapshot",
     "workflow.canary",  # driven in tests/test_fleet_compiler.py (chaos mark)
@@ -210,6 +212,36 @@ def test_env_grammar_and_pre_registration():
         resilience.configure_from_env("chaos.test.bad=explode")
     with pytest.raises(ValueError):
         resilience.configure_from_env("chaos.test.bad=error:os.system")
+
+
+def test_transport_fault_kinds():
+    """ISSUE 17 fault grammar: network-class kinds for partition drills
+    — refuse (RST on connect), reset (mid-stream death), blackhole
+    (dropped packets: hang, then timeout)."""
+    n = resilience.configure_from_env(
+        "chaos.test.refuse=refuse,times=1;"
+        "chaos.test.reset=reset,times=1;"
+        "chaos.test.hole=blackhole:0.05,times=1"
+    )
+    assert n == 3
+    with pytest.raises(ConnectionRefusedError):
+        resilience.faultpoint("chaos.test.refuse").fire()
+    with pytest.raises(ConnectionResetError):
+        resilience.faultpoint("chaos.test.reset").fire()
+    t0 = time.perf_counter()
+    with pytest.raises(TimeoutError):
+        resilience.faultpoint("chaos.test.hole").fire()
+    # the blackhole HANGS before it times out (dropped packets, no RST)
+    assert time.perf_counter() - t0 >= 0.04
+    # exhausted budgets: all three pass clean now
+    for site in ("chaos.test.refuse", "chaos.test.reset", "chaos.test.hole"):
+        resilience.faultpoint(site).fire()
+
+
+def test_transport_kinds_reject_arguments():
+    for clause in ("chaos.test.bad=refuse:x", "chaos.test.bad=reset:9"):
+        with pytest.raises(ValueError, match="takes no argument"):
+            resilience.configure_from_env(clause)
 
 
 def test_quarantine_set_unit():

@@ -40,7 +40,10 @@ _DS = {
 }
 
 
-def fleet_spec(rev=1, window_s=1.0, min_requests=1, canary_overrides=None):
+def fleet_spec(
+    rev=1, window_s=1.0, min_requests=1, canary_overrides=None,
+    gameday_gate=None,
+):
     """8 machines across 2 feature-count buckets (5x3 tags + 3x2 tags) —
     the acceptance shape — with a short canary window for test speed."""
     machines = [
@@ -85,6 +88,10 @@ def fleet_spec(rev=1, window_s=1.0, min_requests=1, canary_overrides=None):
                 **(canary_overrides or {}),
             },
             "schedules": {"refit_every": "6h"},
+            **(
+                {"gameday": {"gate": list(gameday_gate)}}
+                if gameday_gate is not None else {}
+            ),
         },
     }
 
@@ -372,6 +379,80 @@ class TestCompile:
         assert names == sorted(
             s.payload["machine"]["name"] for s in dag.by_kind("build")
         )
+
+
+# ---------------------------------------------------------------------- #
+# gameday gate compilation (ISSUE 17: pre-promotion drills in the DAG)
+# ---------------------------------------------------------------------- #
+
+
+class TestGamedayGateCompile:
+    GATE = ["replica_crash_restart", "gray_failure_slow_replica"]
+
+    def test_gate_step_sits_between_canary_and_promote(self):
+        dag = compile_fleet(fleet_spec(gameday_gate=self.GATE), "proj")
+        gd = dag.steps["gameday/fleet"]
+        assert gd.kind == "gameday"
+        assert gd.deps == ("canary/fleet",)
+        assert gd.payload == {"scenarios": self.GATE}
+        promote = dag.steps["promote/fleet"]
+        assert set(promote.deps) == {"canary/fleet", "gameday/fleet"}
+        order = [s.step_id for s in dag.order()]
+        assert order.index("canary/fleet") < order.index("gameday/fleet")
+        assert order.index("gameday/fleet") < order.index("promote/fleet")
+        assert dag.meta["fleet"]["gameday_gate"] == self.GATE
+
+    def test_no_gate_declared_no_gameday_step(self):
+        """Golden-DAG stability: specs without fleet.gameday compile
+        exactly the pre-gate shape (promote keyed on canary alone)."""
+        dag = compile_fleet(fleet_spec(), "proj")
+        assert "gameday/fleet" not in dag.steps
+        assert dag.steps["promote/fleet"].deps == ("canary/fleet",)
+        assert "gameday_gate" not in dag.meta["fleet"]
+
+    def test_gate_key_chains_canary_and_scenario_set(self):
+        """Editing the drill set re-keys the gate AND promote (a gate
+        edit must re-drill and re-promote) but not the canary."""
+        a = compile_fleet(fleet_spec(gameday_gate=self.GATE), "proj")
+        b = compile_fleet(
+            fleet_spec(gameday_gate=["replica_crash_restart"]), "proj"
+        )
+        assert a.steps["canary/fleet"].key == b.steps["canary/fleet"].key
+        assert a.steps["gameday/fleet"].key != b.steps["gameday/fleet"].key
+        assert a.steps["promote/fleet"].key != b.steps["promote/fleet"].key
+        stale = b.stale_steps(
+            {s.step_id: s.key for s in a.order()}
+        )
+        assert set(stale) == {"gameday/fleet", "promote/fleet"}
+
+    def test_gate_compiles_deterministically(self):
+        a = compile_fleet(fleet_spec(gameday_gate=self.GATE), "proj")
+        b = compile_fleet(fleet_spec(gameday_gate=self.GATE), "proj")
+        assert [(s.step_id, s.key) for s in a.order()] == [
+            (s.step_id, s.key) for s in b.order()
+        ]
+
+    def test_unknown_scenario_rejected_at_compile(self):
+        with pytest.raises(ValueError, match="unknown gameday scenario"):
+            compile_fleet(fleet_spec(gameday_gate=["no_such_drill"]), "proj")
+
+    def test_non_gate_capable_scenario_rejected_at_compile(self):
+        """Fleet-scope scenarios (needing a whole mesh) cannot be
+        declared as single-replica promotion gates."""
+        with pytest.raises(ValueError, match="no gate-mode drill"):
+            compile_fleet(
+                fleet_spec(gameday_gate=["watchman_partition"]), "proj"
+            )
+
+    def test_empty_gate_list_rejected(self):
+        with pytest.raises(ValueError, match="non-empty list"):
+            compile_fleet(fleet_spec(gameday_gate=[]), "proj")
+
+    def test_unknown_gameday_key_rejected(self):
+        spec = fleet_spec()
+        spec["fleet"]["gameday"] = {"gates": ["replica_crash_restart"]}
+        with pytest.raises(ValueError, match="fleet.gameday keys"):
+            compile_fleet(spec, "proj")
 
 
 # ---------------------------------------------------------------------- #
@@ -765,3 +846,102 @@ class TestExecutorLive:
         rep = ex.run()
         assert rep["promoted"]
         assert not ex.refit_due()  # 6h cadence, just promoted
+
+
+@pytest.mark.slow
+@pytest.mark.gameday
+class TestGamedayGateLive:
+    """ISSUE 17: the pre-promotion game-day gate against a live replica
+    — drills pass on a healthy canary (and cache), block promote when
+    they fail, and a real injected fault fails the real drill."""
+
+    GATE = ["replica_crash_restart", "gray_failure_slow_replica"]
+
+    def test_gate_passes_on_healthy_canary_then_caches(self, live):
+        codes = []
+        rep = _executor(
+            live, rev=1, traffic_hook=_traffic(codes),
+            gameday_gate=self.GATE,
+        ).run()
+        assert not rep["failed"] and rep["promoted"], rep
+        assert rep["steps"]["gameday/fleet"]["status"] == "ok"
+        gate = rep["gameday_gate"]
+        assert gate["schema"] == "gordo.gameday-gate/v1" and gate["passed"]
+        assert set(gate["scenarios"]) == set(self.GATE)
+        for v in gate["scenarios"].values():
+            assert v["passed"] and not v["failures"], v
+            assert v["probe_requests"] > 0
+        # the swap invariant was judged with real traffic in flight
+        reload_v = gate["scenarios"]["replica_crash_restart"]
+        assert reload_v["non_200"] == 0 and reload_v["swap"] is not None
+        assert codes and set(codes) == {200}
+        # a re-run with identical keys reuses the drilled verdict
+        rep2 = _executor(
+            live, rev=1, traffic_hook=_traffic([]),
+            gameday_gate=self.GATE,
+        ).run()
+        assert rep2["steps"]["gameday/fleet"]["status"] == "cached"
+
+    def test_failed_gate_blocks_promote(self, live, monkeypatch):
+        """Executor wiring: a failed gate doc -> failed step -> promote
+        blocked by ordinary dep propagation, verdict in the report."""
+        from gordo_components_tpu.gameday import gate as gate_mod
+        from gordo_components_tpu.replay.verdict import finalize_verdict
+
+        def rigged(base_url, project, scenarios=None, **kw):
+            v = finalize_verdict(
+                {"scenario": "replica_crash_restart", "non_200": 3},
+                ["3 non-200(s) during the swap window"],
+            )
+            return {
+                "schema": gate_mod.GATE_SCHEMA,
+                "base_url": base_url,
+                "scenarios": {"replica_crash_restart": v},
+                "passed": False,
+            }
+
+        monkeypatch.setattr(gate_mod, "run_promotion_gate", rigged)
+        rep = _executor(
+            live, rev=1, traffic_hook=_traffic([]),
+            gameday_gate=["replica_crash_restart"],
+        ).run()
+        assert not rep["promoted"]
+        assert rep["steps"]["gameday/fleet"]["status"] == "failed"
+        assert rep["steps"]["promote/fleet"]["status"] == "blocked"
+        assert not rep["gameday_gate"]["passed"]
+        # failed is not cacheable: the incumbent generation still serves
+        assert rep["generation"] == 0
+
+    @pytest.mark.chaos
+    def test_injected_scoring_fault_fails_the_real_drill(self, live):
+        """End-to-end failure path with no test doubles: arm a real
+        bank.score fault, run the real reload drill with scoring
+        traffic — the server's own error counter convicts the swap."""
+        from gordo_components_tpu import resilience
+        from gordo_components_tpu.gameday.gate import run_promotion_gate
+
+        codes = []
+        resilience.arm("bank.score", times=1000, exc=RuntimeError)
+        try:
+            doc = run_promotion_gate(
+                live["server"].url, "proj",
+                scenarios=["replica_crash_restart"],
+                traffic=_traffic(codes), settle_s=0.4,
+            )
+        finally:
+            resilience.reset()
+        assert not doc["passed"]
+        v = doc["scenarios"]["replica_crash_restart"]
+        assert not v["passed"] and v["non_200"] > 0
+        assert any("non-200" in f for f in v["failures"]), v["failures"]
+        assert codes and all(c >= 400 for c in codes), set(codes)
+
+    def test_unknown_gate_scenario_raises_not_skips(self, live):
+        from gordo_components_tpu.gameday.gate import run_promotion_gate
+
+        with pytest.raises(ValueError, match="unknown gameday scenario"):
+            run_promotion_gate(live["server"].url, "proj", ["nope"])
+        with pytest.raises(ValueError, match="no gate-mode drill"):
+            run_promotion_gate(
+                live["server"].url, "proj", ["migration_storm"]
+            )

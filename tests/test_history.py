@@ -550,6 +550,79 @@ async def test_fleet_slo_serves_last_good_with_staleness(collection_dir):
     assert rep["worst"] == first["replicas"][0]["worst"]
 
 
+async def test_incidents_degrade_when_one_replica_mid_crash(
+    collection_dir, monkeypatch
+):
+    """Game-day regression: the watchman's ``/incidents`` join must
+    DEGRADE, not raise, when one replica of the fleet is mid-crash —
+    its ``/history`` and ``/events`` fetches fail at the transport, but
+    the surviving replica's retained series still correlate and the
+    body counts exactly the live replica."""
+    import asyncio
+    import socket
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gordo_components_tpu.server import build_app
+    from gordo_components_tpu.watchman.server import build_watchman_app
+
+    monkeypatch.setenv("GORDO_HISTORY", "1")
+    monkeypatch.setenv("GORDO_HISTORY_INTERVAL_S", "0.1")
+    monkeypatch.setenv("GORDO_HISTORY_TIERS", "0.1s@5m")
+    server = TestServer(build_app(collection_dir))
+    await server.start_server()
+    base = f"http://{server.host}:{server.port}"
+    # the mid-crash replica: a port that was live a moment ago and now
+    # refuses connections (bind, read the port, close)
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    dead_port = sock.getsockname()[1]
+    sock.close()
+    dead = f"http://127.0.0.1:{dead_port}"
+    try:
+        rng = np.random.RandomState(3)
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            for _ in range(4):  # give the live replica retained samples
+                async with session.post(
+                    f"{base}/gordo/v0/t/m-1/anomaly/prediction",
+                    json={"X": rng.rand(16, 3).tolist()},
+                ) as resp:
+                    assert resp.status == 200
+        await asyncio.sleep(0.35)  # a few background sampler ticks
+
+        wapp = build_watchman_app(
+            "t",
+            base,
+            metrics_urls=[
+                f"{base}/gordo/v0/t/metrics",
+                f"{dead}/gordo/v0/t/metrics",
+            ],
+        )
+        wclient = TestClient(TestServer(wapp))
+        await wclient.start_server()
+        try:
+            resp = await wclient.get(
+                "/incidents", params={"threshold": "1.0"}
+            )
+            assert resp.status == 200  # degraded, never a 500
+            body = await resp.json()
+            assert body["replicas_with_history"] == 1
+            assert body["replicas_scraped"] == 1
+            assert "incidents" in body and "detected" in body
+            # /history attributes the crash to the right replica index
+            hist = await (await wclient.get("/history")).json()
+            assert hist["replicas_scraped"] == 1
+            assert hist["replicas"][0]["scraped"] is True
+            assert hist["replicas"][1]["scraped"] is False
+            assert hist["replicas"][1]["enabled"] is False
+        finally:
+            await wclient.close()
+    finally:
+        await server.close()
+
+
 @pytest.mark.slow
 async def test_gameday_incident_detected_with_ordered_timeline(
     collection_dir, monkeypatch

@@ -566,6 +566,91 @@ async def test_client_stale_table_refetches_and_reroutes(mesh_dir):
         await stop_mesh(mesh)
 
 
+async def test_replica_dark_steps_version_and_emits_mesh_events(mesh_dir):
+    """ISSUE 17 satellite: a replica going dark is a routing event in
+    its own right — the reachable True->False transition MUST step the
+    table version (clients polling the version stop posting at the dead
+    owner) and emit ``mesh.replica_unreachable``; the heal steps the
+    version again and emits ``mesh.replica_recovered``."""
+    from gordo_components_tpu import resilience
+
+    mesh = await start_mesh(mesh_dir, refresh_interval=300.0)
+    try:
+        resp = await mesh.watchman.get("/routing?refresh=1")
+        v0 = (await resp.json())["version"]
+        # transport-partition every probe for exactly one rebuild round
+        # (2 replicas = 2 probes)
+        resilience.configure_from_env("watchman.probe=refuse,times=2")
+        resp = await mesh.watchman.get("/routing?refresh=1")
+        dark = await resp.json()
+        assert dark["version"] > v0
+        assert all(not r["reachable"] for r in dark["replicas"])
+        # fault budget exhausted: the next rebuild observes the heal
+        resp = await mesh.watchman.get("/routing?refresh=1")
+        healed = await resp.json()
+        assert healed["version"] > dark["version"]
+        assert all(r["reachable"] for r in healed["replicas"])
+        assert sorted(healed["members"]) == MEMBERS
+        # both transitions are timeline events the incident stack reads
+        resp = await mesh.watchman.get(
+            "/events?type=mesh.replica_unreachable,mesh.replica_recovered"
+        )
+        events = (await resp.json())["events"]
+        types = [e["type"] for e in events]
+        assert "mesh.replica_unreachable" in types
+        assert "mesh.replica_recovered" in types
+        assert types.index("mesh.replica_unreachable") < types.index(
+            "mesh.replica_recovered"
+        )
+        down = next(
+            e for e in events if e["type"] == "mesh.replica_unreachable"
+        )
+        assert down["severity"] == "error"
+    finally:
+        resilience.reset()
+        await stop_mesh(mesh)
+
+
+async def test_forced_refresh_rate_limited_per_member(mesh_dir):
+    """ISSUE 17 satellite: stale-table forced refreshes are rate-limited
+    per member — a migration storm of 404s must not stampede watchman —
+    and suppressed calls count
+    ``gordo_client_routing_refreshes_throttled_total``."""
+    import aiohttp
+
+    from gordo_components_tpu.observability import get_registry
+
+    mesh = await start_mesh(mesh_dir)
+    try:
+        client = _routed_client(mesh, routing_refresh_window_s=60.0)
+        async with aiohttp.ClientSession() as session:
+            assert await client._fetch_routing(session) is True  # install
+            # the member's FIRST forced refresh is entitled to hit the
+            # network (stale-table recovery must work)
+            await client._fetch_routing(session, force=True, member="mesh-0")
+            assert client._fanout_stats["refreshes_throttled"] == 0
+            fetched = client._fanout_stats["routing_refreshes"]
+            # a second within the window is suppressed network-free
+            assert (
+                await client._fetch_routing(
+                    session, force=True, member="mesh-0"
+                )
+                is False
+            )
+            assert client._fanout_stats["refreshes_throttled"] == 1
+            assert client._fanout_stats["routing_refreshes"] == fetched
+            # a different member owns its own window
+            await client._fetch_routing(session, force=True, member="mesh-1")
+            assert client._fanout_stats["refreshes_throttled"] == 1
+        text = get_registry().render()
+        assert "gordo_client_routing_refreshes_throttled_total" in text
+        snap = get_registry().snapshot()
+        vals = snap["gordo_client_routing_refreshes_throttled_total"]["values"]
+        assert any(v["value"] == 1 for v in vals)
+    finally:
+        await stop_mesh(mesh)
+
+
 def test_hedge_skips_degraded_and_quarantining_replicas():
     """The satellite fix: a hedge must never land on the replica the
     table marks sick — the OLD client hedged to any other replica, which

@@ -582,6 +582,90 @@ async def test_push_unknown_target_404(artifact_dir, monkeypatch):
         assert r.status == 404
 
 
+async def test_push_subscriber_delivers_and_keeps_identity(
+    artifact_dir, monkeypatch
+):
+    """ISSUE 17 satellite: the PushSubscriber client loop — one poll
+    delivers the scored batch, and the server-minted subscriber id is
+    kept across polls (no re-registration per poll)."""
+    import time as _time
+
+    from gordo_components_tpu.client.subscribe import PushSubscriber
+
+    async with push_app(artifact_dir, monkeypatch) as client:
+        sub = PushSubscriber("", "p", "sat-a", poll_timeout_s=8.0)
+        poll = asyncio.ensure_future(sub.poll_once(client))
+        await asyncio.sleep(0.1)
+        now = _time.time()
+        r = await client.post(
+            "/gordo/v0/p/sat-a/ingest",
+            json={
+                "rows": _x(40).tolist(),
+                "timestamps": [now + i for i in range(40)],
+            },
+        )
+        assert r.status == 200
+        batch = await poll
+        assert len(batch) == 1 and batch[0]["rows"] == 40
+        assert sub.stats["polls"] == 1
+        minted = sub.subscriber
+        assert minted  # server-minted id echoed and kept
+        await sub.poll_once(client)
+        assert sub.subscriber == minted
+
+
+async def test_push_subscriber_reconnects_with_decorrelated_jitter(
+    artifact_dir, monkeypatch
+):
+    """ISSUE 17 satellite: failed polls reconnect on a seeded
+    decorrelated-jitter schedule — two subscribers' delays diverge (the
+    herd spreads), one seed replays identically (a replayable game
+    day), and delays respect base/cap."""
+    import random
+
+    from gordo_components_tpu.client.subscribe import PushSubscriber
+
+    async with push_app(artifact_dir, monkeypatch) as client:
+        # an unknown target 404s every poll: pure reconnect schedule
+        def make(seed):
+            return PushSubscriber(
+                "", "p", "nope",
+                poll_timeout_s=0.0,
+                reconnect_base_s=0.005,
+                reconnect_cap_s=0.05,
+                rng=random.Random(seed),
+            )
+
+        async def drive(sub, n=6):
+            stop = asyncio.Event()
+            task = asyncio.ensure_future(sub.run(client, stop=stop))
+            deadline = time.monotonic() + 10
+            while (
+                len(sub.reconnect_delays) < n
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.01)
+            stop.set()
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+            return sub
+
+        a = await drive(make(7))
+        b = await drive(make(8))
+        replay = await drive(make(7))
+        assert a.stats["failures"] >= 6 and a.stats["reconnects"] >= 6
+        # jittered: the schedule is not a fixed-step ladder
+        assert len(set(round(d, 9) for d in a.reconnect_delays)) >= 4
+        # decorrelated across subscribers: different seeds, different
+        # schedules — the herd does not reconnect in lockstep
+        assert a.reconnect_delays[:6] != b.reconnect_delays[:6]
+        # seeded: the same seed replays the same schedule
+        assert a.reconnect_delays[:6] == replay.reconnect_delays[:6]
+        for d in a.reconnect_delays:
+            assert 0.0 < d <= 0.05
+
+
 # --------------------------------------------------------------------- #
 # perf guards (make perf-guard)
 # --------------------------------------------------------------------- #
