@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history gameday hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo gameday-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history gameday heat hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo gameday-demo capacity-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -131,6 +131,17 @@ history:
 gameday:
 	$(PYTHON) -m pytest tests/ -q -m gameday --continue-on-collection-errors
 
+# heat lane: the access-heat & device-cost observatory — decayed
+# per-member heat math (decay identity, tiers, eviction, steady state),
+# the skewed-load acceptance (4 hot members at 8x rank hottest on
+# GET /heat, watchman rollup byte-for-byte), per-bucket FLOPs/MFU
+# attribution on GET /costs for every live bucket (mixed dense/LSTM
+# archs), analytic-FLOPs-vs-XLA cost_analysis cross-check, the metric
+# cardinality guard (GORDO_METRIC_MAX_SERIES), heat surviving /reload
+# swaps, and the <=5% hot-loop overhead guard (tests/test_heat_cost.py)
+heat:
+	$(PYTHON) -m pytest tests/ -q -m heat --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -231,6 +242,14 @@ incident-demo:
 # leg runs a 3-scenario subset of the same tool)
 gameday-demo:
 	$(PYTHON) tools/gameday_demo.py
+
+# capacity advisor on a live skewed fleet: drives 4x-hot traffic over a
+# mixed dense/LSTM bank, reads GET /heat + GET /costs + bank capacity,
+# and prints the advisor tables (tier split, per-bucket MFU league,
+# projected members per HBM budget per dtype) + one JSON doc
+# (tools/capacity_demo.py; bench.py's `heat_cost` leg runs the same tool)
+capacity-demo:
+	$(PYTHON) tools/capacity_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -930,6 +930,44 @@ def bench_history(burn_seconds=2.0):
     }
 
 
+def bench_heat_cost():
+    """Fleet heat & device-cost observatory (ISSUE 18) — the capacity
+    advisor drill via tools/capacity_demo.py: skewed load over a mixed
+    dense/LSTM fleet, then ``GET /heat`` (the hot quartet must rank
+    hottest), ``GET /costs`` (a live MFU for every bucket), and the
+    bank-capacity projection (members per HBM budget per storage
+    dtype). Records the tier split, per-bucket MFU, the fix-this-first
+    pad-waste ranking, and the models/GB projection. Subprocess so the
+    GORDO_HEAT/GORDO_COST cadence knobs land before server import."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "capacity_demo.py"
+    )
+    out = subprocess.run(
+        [sys.executable, tool, "--platform", "cpu"],
+        capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"capacity demo failed: {' | '.join(tail[-3:])}")
+    lines = out.stdout.splitlines()
+    start = max(i for i, ln in enumerate(lines) if ln.strip() == "{")
+    doc = json.loads("\n".join(lines[start:]))
+    assert doc["passed"], doc
+    assert doc["tiers"].get("hot", 0) >= 1, doc["tiers"]
+    assert doc["mfu_by_bucket"], doc
+    return {
+        "heat_tiers": doc["tiers"],
+        "heat_hottest": doc["hottest"],
+        "heat_rate_total": doc["rate_total"],
+        "cost_peak_source": doc["peak_source"],
+        "cost_mfu_by_bucket": doc["mfu_by_bucket"],
+        "cost_fix_first": doc["fix_first"],
+        "capacity_models_per_gb": doc["models_per_gb"],
+        "heat_cost": doc,
+    }
+
+
 def bench_fleet_compile(members_compile=2048, demo_members=8):
     """Declarative fleet compiler (ISSUE 15) — two measurements:
 
@@ -1731,6 +1769,7 @@ METRICS = (
     ("replay", bench_replay),
     ("fleet_compile", bench_fleet_compile),
     ("history", bench_history),
+    ("heat_cost", bench_heat_cost),
     ("serving_saturation", bench_serving_saturation),
     ("mesh_serving", bench_mesh_serving),
     ("gameday", bench_gameday),

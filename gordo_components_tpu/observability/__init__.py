@@ -14,9 +14,19 @@ from gordo_components_tpu.observability.events import (
     get_event_log,
     set_event_log,
 )
+from gordo_components_tpu.observability.cost import (
+    CostModel,
+    cost_from_env,
+    merge_cost_snapshots,
+)
 from gordo_components_tpu.observability.goodput import (
     GoodputLedger,
     attribute_trace,
+)
+from gordo_components_tpu.observability.heat import (
+    HeatAccountant,
+    heat_from_env,
+    merge_heat_snapshots,
 )
 from gordo_components_tpu.observability.metrics import (
     Histogram,
@@ -46,9 +56,11 @@ from gordo_components_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "CostModel",
     "Event",
     "EventLog",
     "GoodputLedger",
+    "HeatAccountant",
     "Histogram",
     "HistoryStore",
     "MetricsRegistry",
@@ -58,12 +70,16 @@ __all__ = [
     "Tracer",
     "attribute_trace",
     "chrome_trace",
+    "cost_from_env",
     "current_trace",
     "format_traceparent",
     "get_event_log",
     "get_registry",
     "get_tracer",
+    "heat_from_env",
     "history_from_env",
+    "merge_cost_snapshots",
+    "merge_heat_snapshots",
     "merge_slo_snapshots",
     "parse_prometheus_text",
     "parse_traceparent",

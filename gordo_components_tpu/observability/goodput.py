@@ -99,6 +99,11 @@ class GoodputLedger:
         self.stage_s = {"coalesce": 0.0, "pad": 0.0, "postprocess": 0.0}
         # bucket label -> [useful_s, padded_s, failed_s]
         self.per_bucket: Dict[str, List[float]] = {}
+        # bucket label -> [routed_rows, padded_rows] — summed from the
+        # same shard_rows tuples the per-shard cells consume; the cost
+        # model's real-vs-padded row split per bucket (observability/
+        # cost.py) without a second hot-path tally
+        self.bucket_rows: Dict[str, List[float]] = {}
         # shard label -> [routed_rows, padded_rows]
         self.per_shard: Dict[str, List[float]] = {}
         if registry is not None:
@@ -157,12 +162,17 @@ class GoodputLedger:
         else:
             cells[2] += useful_s
         cells[1] += padded_s
+        brows = self.bucket_rows.get(bucket)
+        if brows is None:
+            brows = self.bucket_rows[bucket] = [0.0, 0.0]
         for shard, routed, padded in shard_rows:
             rows = self.per_shard.get(shard)
             if rows is None:
                 rows = self.per_shard[shard] = [0.0, 0.0]
             rows[0] += routed
             rows[1] += padded
+            brows[0] += routed
+            brows[1] += padded
 
     def finish_request(
         self,
@@ -267,6 +277,8 @@ class GoodputLedger:
                     "useful_s": round(u, 6),
                     "padded_s": round(p, 6),
                     "failed_s": round(f, 6),
+                    "routed_rows": int(self.bucket_rows.get(label, (0, 0))[0]),
+                    "padded_rows": int(self.bucket_rows.get(label, (0, 0))[1]),
                 }
                 for label, (u, p, f) in sorted(list(self.per_bucket.items()))
             },

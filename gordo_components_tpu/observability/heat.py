@@ -1,0 +1,493 @@
+"""Per-member access heat: who is actually hot in a million-member bank?
+
+``ModelBank.model_rows`` (the placement planner's load signal) is a
+plain cumulative row counter — it can say who was ever busy, never who
+is busy *now*, and a ``/reload`` resets it. This module grows that
+signal into a decayed access-heat accountant: every routed row feeds an
+exponentially-decayed per-member accumulator (half-life
+``GORDO_HEAT_HALFLIFE_S``), whose steady state is proportional to the
+member's current routed-row *rate*. The tiered-bank ROADMAP item (hot
+members fp32 in HBM / warm bf16 / cold int8 or host) and the placement
+planner both read the same ranked list this produces.
+
+Design constraints, in order:
+
+- **Hot-path honesty** — the scoring executor pays ONE dict get+set per
+  request (into ``pending``), exactly the cost ``model_rows`` already
+  pays; all decay math is amortized into ``sample()`` (update on read,
+  never per request). ``GORDO_HEAT=0`` means the accountant does not
+  exist and the bank pays one ``None`` check (the same disabled
+  contract as the goodput ledger, held by the hot-loop guard in
+  tests/test_heat_cost.py).
+- **Bounded cardinality** — the registry exposition NEVER emits a
+  per-member series (``gordo_drift_score{model}`` already made that
+  mistake once): heat exports three tier-count gauges and one log-binned
+  rate histogram. Per-member detail is served raw over ``GET /heat``
+  (bounded by ``?top=``), which is JSON, not a scrape.
+- **No-drift** — the snapshot is computed from the folded state alone
+  and cached until the next sample lands; the registry collector, the
+  ``/heat`` body, and the ``/stats`` embed read the SAME cache, and the
+  watchman fleet rollup (:func:`merge_heat_snapshots`) reproduces a
+  single replica's body byte-for-byte.
+- **Swap survival** — the accountant is app-level state handed to every
+  bank generation (placement/swap.py ``build_bank``), so a ``/reload``
+  or rebalance swap changes which bank *feeds* it without resetting the
+  decayed history; the ``bank_heat`` collector key rides the swap's
+  collector-preservation path for rollback.
+
+Decay math: a member's heat cell ``H`` holds decayed routed rows; each
+fold multiplies by ``0.5 ** (dt / halflife)`` and adds the pending rows.
+At a steady routed-row rate ``r`` the cell converges to
+``r * halflife / ln 2``, so ``rate = H * ln 2 / halflife`` estimates the
+member's current rows/second — the quantity the hot/warm/cold thresholds
+(``GORDO_HEAT_HOT_RATE`` / ``GORDO_HEAT_WARM_RATE``) classify.
+
+Wall time comes from the app's replay-aware clock seam
+(replay/clock.py): under time-compressed replay, heat decays in
+*replayed* seconds, like the SLO windows.
+
+Threading: ``pending`` has one writer (the bank's scoring executor); a
+fold swaps the pending dict pointer, so at most the executor's single
+in-between-get-and-set update can land in the retired dict and be lost
+— a bounded, documented race, never a corrupt read. ``sample`` /
+``snapshot`` take a lock (event loop, render path, watchman scrapes).
+"""
+
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from gordo_components_tpu.observability.metrics import Histogram
+
+__all__ = [
+    "HeatAccountant",
+    "heat_from_env",
+    "merge_heat_snapshots",
+]
+
+_ENV_ENABLE = "GORDO_HEAT"
+
+LN2 = math.log(2.0)
+
+# drop a cell once its decayed heat can no longer influence any tier
+# decision (rate ~ 0 at every plausible threshold) — the memory bound
+# that lets the accountant outlive members that stopped receiving
+# traffic without growing forever
+_EVICT_HEAT_ROWS = 1e-3
+
+# the ?top= ranking served when the query does not say (and the size the
+# fleet rollup asks every replica for by default)
+DEFAULT_TOP_N = 10
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+def _tier_of(rate: float, hot_rate: float, warm_rate: float) -> str:
+    if rate >= hot_rate:
+        return "hot"
+    if rate >= warm_rate:
+        return "warm"
+    return "cold"
+
+
+class HeatAccountant:
+    """Decayed per-member routed-row rate accountant for one serving app.
+
+    ``pending`` is the hot-path mailbox: the bank's scoring executor
+    does ``pending[name] = pending.get(name, 0.0) + rows`` per request
+    and nothing else. Everything heavier folds on the sampling cadence.
+    """
+
+    def __init__(
+        self,
+        halflife_s: Optional[float] = None,
+        hot_rate: Optional[float] = None,
+        warm_rate: Optional[float] = None,
+        sample_interval_s: Optional[float] = None,
+        registry=None,
+        clock: Callable[[], float] = time.time,
+    ):
+        if halflife_s is None:
+            halflife_s = _env_float("GORDO_HEAT_HALFLIFE_S", 300.0)
+        self.halflife_s = max(1e-3, float(halflife_s))
+        if hot_rate is None:
+            hot_rate = _env_float("GORDO_HEAT_HOT_RATE", 10.0)
+        if warm_rate is None:
+            warm_rate = _env_float("GORDO_HEAT_WARM_RATE", 1.0)
+        self.hot_rate = float(hot_rate)
+        self.warm_rate = float(warm_rate)
+        if self.warm_rate > self.hot_rate:
+            raise ValueError(
+                f"GORDO_HEAT_WARM_RATE ({self.warm_rate}) must not exceed "
+                f"GORDO_HEAT_HOT_RATE ({self.hot_rate})"
+            )
+        if sample_interval_s is None:
+            sample_interval_s = _env_float("GORDO_HEAT_SAMPLE_S", 10.0)
+        self.sample_interval_s = max(0.001, float(sample_interval_s))
+        self._clock = clock
+        # hot-path mailbox (single writer: the scoring executor)
+        self.pending: Dict[str, float] = {}
+        # folded decayed state: member -> heat (decayed rows)
+        self._heat: Dict[str, float] = {}
+        self._last_fold: Optional[float] = None
+        self._lock = threading.Lock()
+        self._cached: Optional[Dict[str, Any]] = None
+        # (member, rate) descending — the ranked() source, rebuilt per fold
+        self._rates: List[Tuple[str, float]] = []
+        self._histogram: Optional[Histogram] = None
+        self._n_samples = 0
+        # current bank generation's member -> bucket-label map supplier
+        # (set by the bank via bind_bank); a weakref-free callable so a
+        # dropped bank generation cannot be pinned by its accountant
+        self._bucket_map_fn: Optional[Callable[[], Dict[str, str]]] = None
+        if registry is not None:
+            # the swap's collector-preservation key (placement/swap.py
+            # _BANK_COLLECTOR_KEYS): a rolled-back bank swap restores
+            # this exact registration, so the heat series never gap
+            registry.collector(self._collect, key="bank_heat")
+
+    # ------------------------------------------------------------------ #
+    # construction / binding
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_env(cls, registry=None, clock: Callable[[], float] = time.time):
+        """An accountant, or ``None`` when ``GORDO_HEAT=0`` — absence IS
+        the disabled state (one ``None`` check on the scoring path)."""
+        if os.environ.get(_ENV_ENABLE, "1") == "0":
+            return None
+        return cls(registry=registry, clock=clock)
+
+    def bind_bank(self, bank) -> None:
+        """Point the per-bucket tier breakdown at ``bank``'s current
+        membership. Called at every bank construction (boot and each
+        swap generation) — the heat STATE carries across generations,
+        only the member->bucket attribution follows the live bank."""
+        import weakref
+
+        ref = weakref.ref(bank)
+
+        def _bucket_map() -> Dict[str, str]:
+            b = ref()
+            if b is None:
+                return {}
+            out: Dict[str, str] = {}
+            try:
+                for bucket in b.placement()["buckets"]:
+                    for name in bucket["members"]:
+                        out[name] = bucket["bucket"]
+            except Exception:
+                return {}
+            return out
+
+        with self._lock:
+            self._bucket_map_fn = _bucket_map
+            self._cached = None  # attribution changed; rebuild on next read
+
+    # ------------------------------------------------------------------ #
+    # sampling / decay
+    # ------------------------------------------------------------------ #
+
+    def _fold(self, now: float) -> None:
+        """Decay all cells to ``now`` and absorb the pending mailbox
+        (lock held). The ONLY place decay math runs — update on read."""
+        pending, self.pending = self.pending, {}
+        last = self._last_fold
+        heat = self._heat
+        if last is not None and now > last:
+            decay = 0.5 ** ((now - last) / self.halflife_s)
+            for name in list(heat):
+                cell = heat[name] * decay
+                if cell < _EVICT_HEAT_ROWS and name not in pending:
+                    del heat[name]
+                else:
+                    heat[name] = cell
+        for name, rows in pending.items():
+            heat[name] = heat.get(name, 0.0) + rows
+        self._last_fold = now
+
+    def sample(self, now: Optional[float] = None, force: bool = False) -> bool:
+        """Fold + rebuild the cached snapshot if the cadence (or
+        ``force``) says so; returns whether a sample landed."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            if (
+                not force
+                and self._last_fold is not None
+                and now - self._last_fold < self.sample_interval_s
+            ):
+                return False
+            self._fold(now)
+            self._rebuild(now)
+            self._n_samples += 1
+            return True
+
+    def _rebuild(self, now: float) -> None:
+        """Recompute rates, tiers, the per-bucket breakdown, and the
+        log-binned rate histogram from folded state (lock held)."""
+        rate_of = LN2 / self.halflife_s
+        rates = sorted(
+            ((name, heat * rate_of) for name, heat in self._heat.items()),
+            key=lambda kv: (-kv[1], kv[0]),
+        )
+        bucket_map = self._bucket_map_fn() if self._bucket_map_fn else {}
+        # bank members with no recorded traffic are COLD members, not
+        # invisible ones — the capacity advisor's cold tier must count
+        # them (rate 0.0), so the rank list covers the whole bank
+        heated = {name for name, _ in rates}
+        rates.extend(
+            (name, 0.0)
+            for name in sorted(bucket_map)
+            if name not in heated
+        )
+        tiers = {"hot": 0, "warm": 0, "cold": 0}
+        per_bucket: Dict[str, Dict[str, int]] = {}
+        # rate histogram floor: one decayed row over a half-life; traffic
+        # below that is indistinguishable from cold
+        hist = Histogram(lo=max(1e-6, rate_of), hi=1e7, bins_per_decade=4)
+        total_rate = 0.0
+        for name, rate in rates:
+            tier = _tier_of(rate, self.hot_rate, self.warm_rate)
+            tiers[tier] += 1
+            total_rate += rate
+            label = bucket_map.get(name)
+            if label is not None:
+                cell = per_bucket.setdefault(
+                    label, {"hot": 0, "warm": 0, "cold": 0}
+                )
+                cell[tier] += 1
+            if rate > 0.0:
+                hist.record(rate)
+        self._rates = rates
+        self._histogram = hist
+        self._cached = {
+            "halflife_s": self.halflife_s,
+            "hot_rate": self.hot_rate,
+            "warm_rate": self.warm_rate,
+            "sample_interval_s": self.sample_interval_s,
+            "n_samples": self._n_samples + 1,
+            "sampled_at": round(now, 3),
+            "members_tracked": len(self._heat),
+            "members_total": len(rates),
+            "tiers": tiers,
+            "per_bucket": {
+                label: dict(cell) for label, cell in sorted(per_bucket.items())
+            },
+            "rate_total": round(total_rate, 6),
+            # per-bin (upper_edge, members) pairs of the member-rate
+            # distribution — the bounded-cardinality fleet view of "how
+            # skewed is the traffic", without a per-member series
+            "histogram": [
+                [None if math.isinf(edge) else round(edge, 6), int(n)]
+                for edge, n in _plain_bins(hist)
+                if n
+            ],
+        }
+
+    # ------------------------------------------------------------------ #
+    # reads
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Tier counts + distribution, computed from folded state alone
+        and cached until the next sample (the no-drift contract: the
+        registry collector, ``/heat``, ``/stats``, and the fleet rollup
+        all read this)."""
+        self.sample()  # lands only if the cadence is due
+        with self._lock:
+            if self._cached is None:
+                self._fold(self._clock())
+                self._rebuild(self._last_fold or self._clock())
+            return self._cached
+
+    def ranked(self, top_n: int = DEFAULT_TOP_N) -> Dict[str, Any]:
+        """Hottest/coldest ``top_n`` members from the SAME cached fold
+        the snapshot reads — deterministic between samples. Ties rank
+        alphabetically, so equal-rate members order stably."""
+        self.snapshot()
+        with self._lock:
+            n = max(0, int(top_n))
+            hottest = [
+                self._entry(name, rate) for name, rate in self._rates[:n]
+            ]
+            coldest = [
+                self._entry(name, rate)
+                for name, rate in sorted(
+                    self._rates, key=lambda kv: (kv[1], kv[0])
+                )[:n]
+            ]
+            return {"top": n, "hottest": hottest, "coldest": coldest}
+
+    def _entry(self, name: str, rate: float) -> Dict[str, Any]:
+        bucket_map = self._bucket_map_fn() if self._bucket_map_fn else {}
+        # tier from the ROUNDED rate: the fleet merge only sees rounded
+        # rates from replica bodies, so deriving from anything more
+        # precise here would break the byte-for-byte rollup identity
+        rate = round(rate, 6)
+        return {
+            "member": name,
+            "rate": rate,
+            "tier": _tier_of(rate, self.hot_rate, self.warm_rate),
+            "bucket": bucket_map.get(name),
+        }
+
+    def rates(self) -> Dict[str, float]:
+        """member -> estimated routed rows/second, from the cached fold
+        (the placement planner / capacity advisor's raw feed)."""
+        self.snapshot()
+        with self._lock:
+            return {name: rate for name, rate in self._rates}
+
+    def _collect(self):
+        """Registry exposition — tier counts + the rate histogram, NEVER
+        a per-member series (the cardinality contract)."""
+        snap = self.snapshot()
+        for tier, n in sorted(snap["tiers"].items()):
+            yield (
+                "gordo_heat_tier_members", "gauge",
+                "Bank members per access-heat tier (decayed routed-row "
+                "rate vs the hot/warm thresholds)", {"tier": tier}, n,
+            )
+        yield (
+            "gordo_heat_members_tracked", "gauge",
+            "Members with non-evicted decayed heat state", {},
+            snap["members_tracked"],
+        )
+        yield (
+            "gordo_heat_rows_rate", "gauge",
+            "Fleet-summed decayed routed rows/second estimate", {},
+            snap["rate_total"],
+        )
+        with self._lock:
+            hist = self._histogram
+        if hist is not None:
+            yield (
+                "gordo_heat_member_rate", "histogram",
+                "Distribution of per-member decayed routed-row rates "
+                "(log-binned; the bounded-cardinality skew view)", {}, hist,
+            )
+
+
+def _plain_bins(hist: Histogram) -> List[Tuple[float, int]]:
+    """Non-cumulative (upper_edge, count) pairs from a Histogram."""
+    out: List[Tuple[float, int]] = []
+    prev = 0
+    for edge, cum in hist.buckets():
+        out.append((edge, cum - prev))
+        prev = cum
+    return out
+
+
+def heat_from_env(registry=None, clock=None) -> Optional[HeatAccountant]:
+    """Build from env (``GORDO_HEAT=0`` disables). ``clock`` is the
+    app's replay-aware Clock object (replay/clock.py) — heat decays in
+    seam wall seconds; ``None`` falls back to real wall time."""
+    time_fn = clock.time if clock is not None else time.time
+    return HeatAccountant.from_env(registry=registry, clock=time_fn)
+
+
+# ---------------------------------------------------------------------- #
+# fleet rollup (watchman)
+# ---------------------------------------------------------------------- #
+
+
+def merge_heat_snapshots(
+    bodies: Sequence[Optional[Dict[str, Any]]],
+    top_n: int = DEFAULT_TOP_N,
+) -> Dict[str, Any]:
+    """Merge per-replica ``GET /heat`` bodies into one fleet view.
+
+    Per-member rates SUM across replicas (a member served by two
+    replicas is twice as hot fleet-wide; under mesh partitioning each
+    member appears on one replica and the sum is the identity), then
+    re-rank into one fleet hottest/coldest list — the single ranked
+    list a tiered bank or the placement planner reads. Tier counts and
+    the per-bucket breakdown sum per tier. Thresholds come from the
+    first enabled body (fleet config is uniform by deployment contract).
+
+    No-drift: with one replica the merged ``hottest``/``coldest``/
+    ``tiers``/``per_bucket`` reproduce that replica's body byte-for-byte
+    (same rounding, same tie order) — asserted in tests.
+
+    Coverage bound, stated honestly: replicas expose their top/bottom
+    ``top`` members, so the fleet re-rank sees the union of those lists,
+    not every member. ``members_total`` still sums the true counts."""
+    member_rate: Dict[str, float] = {}
+    member_bucket: Dict[str, Optional[str]] = {}
+    tiers = {"hot": 0, "warm": 0, "cold": 0}
+    per_bucket: Dict[str, Dict[str, int]] = {}
+    hot_rate = warm_rate = None
+    members_total = 0
+    rate_total = 0.0
+    scraped = 0
+    for body in bodies:
+        if not body or not body.get("enabled", True):
+            continue
+        scraped += 1
+        if hot_rate is None:
+            hot_rate = float(body.get("hot_rate", 10.0))
+            warm_rate = float(body.get("warm_rate", 1.0))
+        members_total += int(body.get("members_total") or 0)
+        rate_total += float(body.get("rate_total") or 0.0)
+        for tier, n in (body.get("tiers") or {}).items():
+            tiers[tier] = tiers.get(tier, 0) + int(n)
+        for label, cell in (body.get("per_bucket") or {}).items():
+            agg = per_bucket.setdefault(label, {"hot": 0, "warm": 0, "cold": 0})
+            for tier, n in cell.items():
+                agg[tier] = agg.get(tier, 0) + int(n)
+        # union WITHIN the body first: on a small fleet the same member
+        # sits in both hottest and coldest, and summing the two lists
+        # directly would double-count its rate
+        body_rates: Dict[str, Tuple[float, Any]] = {}
+        for entry in list(body.get("hottest") or ()) + list(
+            body.get("coldest") or ()
+        ):
+            name = entry.get("member")
+            if name:
+                body_rates[name] = (
+                    float(entry.get("rate") or 0.0), entry.get("bucket")
+                )
+        for name, (rate, bucket) in body_rates.items():
+            member_rate[name] = member_rate.get(name, 0.0) + rate
+            if member_bucket.get(name) is None:
+                member_bucket[name] = bucket
+    hot_rate = 10.0 if hot_rate is None else hot_rate
+    warm_rate = 1.0 if warm_rate is None else warm_rate
+
+    def entry(name: str) -> Dict[str, Any]:
+        rate = member_rate[name]
+        return {
+            "member": name,
+            "rate": round(rate, 6),
+            "tier": _tier_of(rate, hot_rate, warm_rate),
+            "bucket": member_bucket.get(name),
+        }
+
+    desc = sorted(member_rate, key=lambda n: (-member_rate[n], n))
+    asc = sorted(member_rate, key=lambda n: (member_rate[n], n))
+    n = max(0, int(top_n))
+    return {
+        "replicas_scraped": scraped,
+        "hot_rate": hot_rate,
+        "warm_rate": warm_rate,
+        "members_total": members_total,
+        "rate_total": round(rate_total, 6),
+        "tiers": tiers,
+        "per_bucket": {
+            label: dict(cell) for label, cell in sorted(per_bucket.items())
+        },
+        "top": n,
+        "hottest": [entry(name) for name in desc[:n]],
+        "coldest": [entry(name) for name in asc[:n]],
+    }

@@ -29,6 +29,7 @@ instead of mirrored — mirrored counters drift, read-through ones cannot.
 """
 
 import math
+import os
 import re
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
@@ -58,6 +59,15 @@ _DEF_BPD = 10
 # "Latency histogram resolution") rests on — tune it here or the serving
 # histograms and the SLO good-event counts silently diverge
 LATENCY_BINS_PER_DECADE = 32
+
+# per-metric labeled-series cap (GORDO_METRIC_MAX_SERIES): a family that
+# tries to grow past this many children drops the new series and counts
+# the drop instead of growing the exposition unboundedly. 1024 is far
+# above every legitimate family (buckets, shards, stages, tiers are all
+# O(10)) and far below per-member cardinality at 1M-fleet scale — the
+# guard exists because gordo_drift_score{model} already made that
+# mistake once and the heat/cost series must be unable to repeat it.
+_DEF_MAX_SERIES = 1024
 
 
 class Histogram:
@@ -243,6 +253,7 @@ class MetricFamily:
         help: str,
         labelnames: Tuple[str, ...],
         child_factory: Callable[[], Any],
+        max_series: Optional[int] = None,
     ):
         self.name = name
         self.type = mtype
@@ -250,6 +261,10 @@ class MetricFamily:
         self.labelnames = labelnames
         self._child_factory = child_factory
         self._children: Dict[Tuple[str, ...], Any] = {}
+        self._max_series = max_series
+        # series dropped by the cardinality guard; exposed by the
+        # registry as gordo_metrics_dropped_series_total{metric=...}
+        self.dropped = 0
 
     def labels(self, *values: Any, **kv: Any):
         if kv:
@@ -263,6 +278,17 @@ class MetricFamily:
             )
         child = self._children.get(key)
         if child is None:
+            if (
+                self._max_series is not None
+                and len(self._children) >= self._max_series
+            ):
+                # cardinality guard: hand back a DETACHED child — the
+                # call site's writes land in a cell nothing ever renders
+                # (a runaway label set must not grow the exposition, and
+                # raising here would turn a telemetry bug into a serving
+                # outage)
+                self.dropped += 1
+                return self._child_factory()
             child = self._children[key] = self._child_factory()
         return child
 
@@ -296,10 +322,17 @@ class MetricsRegistry:
     key replaces the previous collector (a rebuilt engine must not leave a
     dead one emitting)."""
 
-    def __init__(self):
+    def __init__(self, max_series_per_metric: Optional[int] = None):
         self._families: Dict[str, MetricFamily] = {}
         self._collectors: Dict[str, Callable[[], Iterable[tuple]]] = {}
         self._lock = threading.Lock()  # registration only, never the hot path
+        if max_series_per_metric is None:
+            raw = os.environ.get("GORDO_METRIC_MAX_SERIES")
+            max_series_per_metric = int(raw) if raw else _DEF_MAX_SERIES
+        # <=0 disables the guard (an operator's explicit escape hatch)
+        self._max_series = (
+            max_series_per_metric if max_series_per_metric > 0 else None
+        )
 
     # --------------------------- registration ------------------------- #
 
@@ -325,7 +358,10 @@ class MetricsRegistry:
                         f"{fam.labelnames}, not {mtype}{tuple(labelnames)}"
                     )
                 return fam
-            fam = MetricFamily(name, mtype, help, tuple(labelnames), child_factory)
+            fam = MetricFamily(
+                name, mtype, help, tuple(labelnames), child_factory,
+                max_series=self._max_series,
+            )
             self._families[name] = fam
             return fam
 
@@ -365,8 +401,18 @@ class MetricsRegistry:
     def _all_samples(self):
         """-> ordered {name: (type, help, [(labels, value), ...])}."""
         out: Dict[str, Tuple[str, str, List[Tuple[Dict[str, str], Any]]]] = {}
+        dropped: List[Tuple[Dict[str, str], Any]] = []
         for fam in list(self._families.values()):
             out[fam.name] = (fam.type, fam.help, list(fam.samples()))
+            if fam.dropped:
+                dropped.append(({"metric": fam.name}, fam.dropped))
+        if dropped:
+            out["gordo_metrics_dropped_series_total"] = (
+                "counter",
+                "Labeled series dropped by the per-metric cardinality "
+                "guard (GORDO_METRIC_MAX_SERIES)",
+                dropped,
+            )
         for fn in list(self._collectors.values()):
             try:
                 rows = list(fn())

@@ -47,8 +47,12 @@ _FP_SWAP = faultpoint("bank.swap")
 
 # registry collectors a bank registers under fixed keys; a rolled-back
 # swap must restore the OLD bank's entries or its series would vanish
-# from the exposition (a scrape gap Prometheus reads as churn)
-_BANK_COLLECTOR_KEYS = ("bank_pipeline", "bank_capacity")
+# from the exposition (a scrape gap Prometheus reads as churn).
+# bank_heat / bank_cost are APP-level accountants (observability/heat.py
+# and cost.py) that follow the live bank rather than belonging to one —
+# snapshotting them alongside keeps a rolled-back swap's exposition
+# byte-identical to the pre-swap one.
+_BANK_COLLECTOR_KEYS = ("bank_pipeline", "bank_capacity", "bank_heat", "bank_cost")
 
 
 def _loop_running() -> bool:
@@ -144,6 +148,11 @@ def build_bank(
         bank_dtype=cfg.get("bank_dtype"),
         bank_kernel=cfg.get("bank_kernel"),
         ledger=app.get("goodput"),
+        # the app-level heat accountant rides into every generation: the
+        # decayed per-member history survives the swap, only the bank
+        # feeding it changes (ModelBank.__init__ re-binds the
+        # member->bucket attribution to the new bank)
+        heat=app.get("heat"),
     )
     bank.build_s = time.monotonic() - t0
     old = app.get("bank")

@@ -552,6 +552,21 @@ def build_app(
         app["slo"] = SLOTracker(
             ledger, registry=registry, clock=app["clock"].monotonic
         )
+    # access-heat accountant + device-cost attribution (observability/
+    # heat.py, cost.py): heat is APP-level state — every bank generation
+    # feeds the same accountant, so the decayed per-member history
+    # survives /reload and rebalance swaps; cost joins the bank's static
+    # FLOPs table to the ledger's measured device seconds on a sampling
+    # cadence. GORDO_HEAT=0 / GORDO_COST=0 disable each plane (the
+    # object is None; the bank pays one None check — the hot-loop
+    # guard's contract). Both decay/sample on the replay-aware clock.
+    from gordo_components_tpu.observability.cost import cost_from_env
+    from gordo_components_tpu.observability.heat import heat_from_env
+
+    app["heat"] = heat_from_env(registry, clock=app["clock"])
+    app["cost"] = cost_from_env(
+        ledger, lambda: app.get("bank"), registry=registry, clock=app["clock"]
+    )
     # multi-host serving mesh (parallel/distributed.py): with
     # GORDO_MESH_REPLICA_ID/GORDO_MESH_REPLICAS set, this process is one
     # replica of a fleet mesh and loads ONLY its deterministic member
@@ -694,6 +709,7 @@ def build_app(
             bank_dtype=bank_dtype,
             bank_kernel=bank_kernel,
             ledger=ledger,
+            heat=app["heat"],
         )
         # expose the bank even when nothing banked: /models reports the
         # coverage (banked vs per-model fallback, with reasons)

@@ -52,6 +52,7 @@ from gordo_components_tpu.models.anomaly.diff import (
 from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.models.train_core import _next_pow2
 from gordo_components_tpu.observability import get_registry
+from gordo_components_tpu.observability.cost import estimate_flops_per_row
 from gordo_components_tpu.ops.pallas_score import (
     banked_anomaly_score,
     resolve_bank_kernel_mode,
@@ -300,6 +301,13 @@ class _Bucket:
         self.n_shards = 1  # mesh model-axis size after finalize()
         self.shard_size = 0  # models per shard (padded stack / n_shards)
         self._sharding = None  # NamedSharding on the model axis (mesh mode)
+        # static cost-attribution feed (observability/cost.py), computed
+        # once by finalize(): analytic forward FLOPs for one routed row
+        # (one scoring window for sequence models) through this bucket's
+        # compiled program
+        self.flops_per_row = 0.0
+        self.flops_method = "unknown"
+        self.params_per_member = 0
 
     @property
     def offset(self) -> int:
@@ -360,6 +368,16 @@ class _Bucket:
         )
         module = lookup_factory(self.registry_type, self.kind)(
             self.n_features, compute_dtype=self.compute_dtype, **self.factory_kwargs
+        )
+        # one member's param count + analytic FLOPs, once per compiled
+        # program — the cost model joins these static numbers to the
+        # ledger's measured device seconds (entries[0]: pad repeats share
+        # the real members' shapes, so any entry works)
+        self.params_per_member = int(
+            sum(np.asarray(l).size for l in jax.tree.leaves(entries[0].params))
+        )
+        self.flops_per_row, self.flops_method = estimate_flops_per_row(
+            module, self.n_features, self.lookback, self.params_per_member
         )
         lookback, t_off, off = self.lookback, self.target_offset, self.offset
         dequant = self.effective_dtype != "float32"
@@ -627,9 +645,19 @@ class ModelBank:
         bank_dtype: Optional[str] = None,
         bank_kernel: Optional[str] = None,
         ledger=None,
+        heat=None,
     ):
         self.max_rows = int(max_rows_per_call)
         self.mesh = mesh
+        # access-heat accountant (observability/heat.py): APP-level state
+        # handed to every bank generation — a /reload or rebalance swap
+        # changes which bank feeds it without resetting the decayed
+        # history (the model_rows cumulative-loss fix). None = heat off,
+        # one attribute check on the scoring path (GORDO_HEAT=0), held
+        # by the tests/test_heat_cost.py hot-loop guard.
+        self.heat = heat
+        if heat is not None:
+            heat.bind_bank(self)
         # goodput ledger (observability/goodput.py): when attached, each
         # bucket group's device window, padded-row split, and host stage
         # seconds are accounted, and every ScoreResult carries its share
@@ -1049,6 +1077,28 @@ class ModelBank:
             "quantize_fallbacks": dict(self.quantize_fallbacks),
         }
 
+    def flops_stats(self) -> Dict[str, Any]:
+        """Static per-bucket FLOPs table (cost model's numerator feed,
+        observability/cost.py): bucket label -> the analytic forward
+        FLOPs per routed row computed once at finalize, plus the shape
+        facts a capacity advisor needs. Finalize-failed buckets are
+        absent — they never burn device time."""
+        out: Dict[str, Any] = {}
+        for b in self._buckets.values():
+            out[b.label] = {
+                "flops_per_row": float(b.flops_per_row),
+                "flops_method": b.flops_method,
+                "params_per_member": int(b.params_per_member),
+                "members": len(b.names),
+                "kind": b.kind,
+                "registry_type": b.registry_type,
+                "n_features": int(b.n_features),
+                "lookback": int(b.lookback),
+                "weight_bytes": int(b.weight_bytes),
+                "effective_dtype": b.effective_dtype,
+            }
+        return out
+
     def pipeline_stats(self) -> Dict[str, Any]:
         """Operator-facing pipeline/arena summary (served in ``/stats``
         as ``bank_pipeline``; bench and the north-star check snapshot it
@@ -1417,6 +1467,11 @@ class ModelBank:
         run.off = off
         rows = [np.asarray(requests[ri][1], np.float32) for ri in req_ids]
         mrows = self.model_rows
+        heat = self.heat
+        # the heat accountant's hot-path mailbox, cached once per group
+        # (observability/heat.py): one dict get+set per request below,
+        # decay math amortized into the accountant's sampling cadence
+        pend = heat.pending if heat is not None else None
         for ri, X in zip(req_ids, rows):
             if X.ndim != 2 or X.shape[1] != F:
                 raise ValueError(
@@ -1435,6 +1490,11 @@ class ModelBank:
                 # on rows, the unit the shard counters already speak)
                 name = requests[ri][0]
                 mrows[name] = mrows.get(name, 0) + X.shape[0]
+                if pend is not None:
+                    pend[name] = pend.get(name, 0.0) + X.shape[0]
+            elif pend is not None:
+                name = requests[ri][0]
+                pend[name] = pend.get(name, 0.0) + X.shape[0]
         # rows-per-call stays a power of two and never exceeds max_rows
         # (but must always cover at least one window + one output row)
         T = min(

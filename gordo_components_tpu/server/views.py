@@ -524,6 +524,50 @@ async def slo_view(request: web.Request) -> web.Response:
     return web.json_response(body)
 
 
+@routes.get("/gordo/v0/{project}/heat")
+async def heat_view(request: web.Request) -> web.Response:
+    """Per-member access heat (observability/heat.py): the decayed
+    routed-row rate accountant's tier counts, per-bucket breakdown, and
+    rate histogram, plus the ``?top=N`` hottest/coldest member rankings
+    (default 10 — the ONLY per-member surface; the registry exports
+    bounded tier/histogram series, never per-member ones).
+
+    The body is the SAME cached snapshot the registry's
+    ``gordo_heat_*`` series render and ``/stats`` embeds (no-drift);
+    ``?refresh=1`` forces a fold first (operator/test hook — the normal
+    cadence is ``GORDO_HEAT_SAMPLE_S``). Watchman's ``GET /heat`` sums
+    these bodies into one fleet-ranked list."""
+    heat = request.app.get("heat")
+    if heat is None:
+        return web.json_response({"enabled": False})
+    if request.query.get("refresh", "").lower() in ("1", "true", "yes"):
+        heat.sample(force=True)
+    body = {"enabled": True, **heat.snapshot()}
+    top = _query_float(request, "top")
+    body.update(heat.ranked(10 if top is None else int(top)))
+    return web.json_response(body)
+
+
+@routes.get("/gordo/v0/{project}/costs")
+async def costs_view(request: web.Request) -> web.Response:
+    """Per-bucket device-cost attribution (observability/cost.py):
+    analytic FLOPs/row × the goodput ledger's measured device seconds
+    and real-vs-padded row split, per bucket — MFU, device-seconds-per-
+    1k-rows, pad-waste score — plus the ``ranking`` list ordering
+    buckets by wasted device time (pad waste × device share).
+
+    The body is the SAME cached join the registry's ``gordo_bucket_*``
+    cost series render and ``/stats`` embeds (no-drift); ``?refresh=1``
+    forces a fresh join. Watchman's ``GET /costs`` sums the raw tallies
+    fleet-wide and recomputes through the same arithmetic."""
+    cost = request.app.get("cost")
+    if cost is None:
+        return web.json_response({"enabled": False})
+    if request.query.get("refresh", "").lower() in ("1", "true", "yes"):
+        cost.sample(force=True)
+    return web.json_response({"enabled": True, **cost.snapshot()})
+
+
 def _query_float(request: web.Request, name: str) -> Optional[float]:
     raw = request.query.get(name)
     if raw in (None, ""):
@@ -687,6 +731,15 @@ async def server_stats(request: web.Request) -> web.Response:
     if tracker is not None:
         # the SLO state GET .../slo serves, embedded verbatim (no-drift)
         body["slo"] = tracker.snapshot()
+    heat = request.app.get("heat")
+    if heat is not None:
+        # the access-heat tiers GET .../heat serves, embedded verbatim
+        # (no-drift; the per-member rankings stay on /heat?top=)
+        body["heat"] = heat.snapshot()
+    cost = request.app.get("cost")
+    if cost is not None:
+        # the per-bucket MFU/cost join GET .../costs serves (no-drift)
+        body["costs"] = cost.snapshot()
     collection = request.app.get("collection")
     if collection is not None:
         body["load_failures"] = {

@@ -27,6 +27,8 @@ from aiohttp import web
 from gordo_components_tpu import __version__
 from gordo_components_tpu.observability import (
     EventLog,
+    merge_cost_snapshots,
+    merge_heat_snapshots,
     merge_slo_snapshots,
     parse_prometheus_text,
     render_samples,
@@ -597,6 +599,61 @@ class WatchmanState:
             for i, body in enumerate(bodies)
         ]
         merged["replicas_scraped"] = sum(live)
+        return merged
+
+    async def fleet_heat(
+        self, top_n: int = 10, refresh: bool = False
+    ) -> Dict[str, Any]:
+        """Fleet access-heat rollup: every replica's ``GET /heat``
+        merged (observability/heat.py::merge_heat_snapshots) — per-
+        member rates SUM across replicas and re-rank into ONE fleet
+        hottest/coldest list (the ranked list a tiered bank or the
+        placement planner reads), tier counts and per-bucket breakdowns
+        sum per tier. Best-effort: an unanswering replica is counted
+        out, never an error. ``refresh`` forces a fold on every replica
+        first; ``top_n`` forwards as each replica's ``?top=``."""
+        params: Dict[int, Any] = {}
+        n = len(self._replica_prefixes())
+        q = {"top": str(int(top_n))}
+        if refresh:
+            q["refresh"] = "1"
+        for i in range(n):
+            params[i] = q
+        bodies = await self._fetch_replica_json("heat", params)
+        merged = merge_heat_snapshots(bodies, top_n=top_n)
+        merged["replicas"] = [
+            {
+                "replica": i,
+                "scraped": body is not None,
+                "heat_enabled": bool(body and body.get("enabled")),
+            }
+            for i, body in enumerate(bodies)
+        ]
+        return merged
+
+    async def fleet_costs(self, refresh: bool = False) -> Dict[str, Any]:
+        """Fleet device-cost rollup: every replica's ``GET /costs``
+        merged (observability/cost.py::merge_cost_snapshots) — raw
+        row/second tallies sum per bucket label, derived MFU/waste
+        fields recompute through the same arithmetic the replicas used
+        (no-drift), and the ranking re-orders fleet-wide."""
+        params: Dict[int, Any] = {}
+        if refresh:
+            n = len(self._replica_prefixes())
+            for i in range(n):
+                params[i] = {"refresh": "1"}
+        bodies = await self._fetch_replica_json(
+            "costs", params if refresh else None
+        )
+        merged = merge_cost_snapshots(bodies)
+        merged["replicas"] = [
+            {
+                "replica": i,
+                "scraped": body is not None,
+                "cost_enabled": bool(body and body.get("enabled")),
+            }
+            for i, body in enumerate(bodies)
+        ]
         return merged
 
     # ------------------------------------------------------------------ #
@@ -1918,6 +1975,30 @@ def build_watchman_app(
                 content_type="application/json",
             )
 
+    async def heat(request: web.Request) -> web.Response:
+        """Fleet access-heat rollup: summed per-member rates re-ranked
+        into one fleet hottest/coldest list, plus summed tier counts
+        and per-bucket breakdowns. ``?top=N`` sizes the rankings;
+        ``?refresh=1`` forces a fold on every replica first."""
+        refresh = request.query.get("refresh", "").lower() in (
+            "1", "true", "yes",
+        )
+        top = _q_float(request, "top")
+        return web.json_response(
+            await state.fleet_heat(
+                top_n=10 if top is None else int(top), refresh=refresh
+            )
+        )
+
+    async def costs(request: web.Request) -> web.Response:
+        """Fleet device-cost rollup: per-bucket tallies summed across
+        replicas, MFU/waste recomputed fleet-wide, ranked by wasted
+        device time. ``?refresh=1`` forces a fresh join per replica."""
+        refresh = request.query.get("refresh", "").lower() in (
+            "1", "true", "yes",
+        )
+        return web.json_response(await state.fleet_costs(refresh=refresh))
+
     async def history(request: web.Request) -> web.Response:
         """Fleet metric-history rollup: every replica's retained rings,
         attributed per replica. ``?series=a,b&since=&until=&step=``
@@ -2073,6 +2154,8 @@ def build_watchman_app(
     app.router.add_get("/traces", traces)
     app.router.add_get("/slo", slo)
     app.router.add_get("/drift", drift)
+    app.router.add_get("/heat", heat)
+    app.router.add_get("/costs", costs)
     app.router.add_get("/history", history)
     app.router.add_get("/events", events)
     app.router.add_get("/incidents", incidents)

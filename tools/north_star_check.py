@@ -76,7 +76,9 @@ def run_check(
     import numpy as np
 
     from gordo_components_tpu.observability import (
+        CostModel,
         GoodputLedger,
+        HeatAccountant,
         MetricsRegistry,
         SLOTracker,
         get_registry,
@@ -153,7 +155,14 @@ def run_check(
     # without a pre-serve baseline every window would be empty and the
     # burn assertions below would pass vacuously
     slo_tracker.sample(force=True)
-    bank = ModelBank.from_models(models, mesh=mesh, registry=registry, ledger=ledger)
+    # heat/cost observatory (ISSUE 18): the access-heat accountant rides
+    # the serve phase's scoring path, the cost model joins the ledger's
+    # device seconds to the bank's analytic FLOPs — both asserted below
+    heat = HeatAccountant(registry=registry)
+    bank = ModelBank.from_models(
+        models, mesh=mesh, registry=registry, ledger=ledger, heat=heat
+    )
+    cost = CostModel(ledger, lambda: bank, registry=registry)
     bank_elapsed = time.time() - t0  # unrounded: CI-sized builds are ~ms
     phase("bank", t0)
     cov = bank.coverage()
@@ -337,6 +346,8 @@ def run_check(
     # visibility this scale exists to prove (VERDICT r5 weak #2 — a hot
     # shard was previously invisible). Asserted sane here so every
     # NORTH_STAR_*.json artifact carries skew evidence automatically. ----
+    heat.sample(force=True)  # fold the serve phase's routed rows now
+    cost.sample(force=True)  # join the ledger's device time to FLOPs
     snap = registry.snapshot()
 
     def series(name, label):
@@ -364,6 +375,37 @@ def run_check(
     assert sum(weight_series.values()) == out["capacity"]["weight_bytes"], (
         weight_series, out["capacity"]["weight_bytes"],
     )
+    # heat/cost observatory (ISSUE 18 contract): a gordo_bucket_mfu
+    # series for EVERY live bucket, heat tiers covering the whole fleet,
+    # and ZERO series dropped by the cardinality guard — the exposition
+    # must stay bounded at 10k members, not grow per member
+    mfu_series = series("gordo_bucket_mfu", "bucket")
+    assert set(mfu_series) >= set(bank.flops_stats()), (
+        set(bank.flops_stats()) - set(mfu_series)
+    )
+    assert all(v is not None and v >= 0 for v in mfu_series.values()), mfu_series
+    heat_snap = heat.snapshot()
+    tier_series = series("gordo_heat_tier_members", "tier")
+    assert sum(tier_series.values()) == heat_snap["members_total"], (
+        tier_series, heat_snap["members_total"],
+    )
+    assert heat_snap["members_total"] == args.members, heat_snap["members_total"]
+    assert "gordo_metrics_dropped_series_total" not in snap, snap.get(
+        "gordo_metrics_dropped_series_total"
+    )
+    out["heat"] = {
+        "tiers": heat_snap["tiers"],
+        "members_total": heat_snap["members_total"],
+        "rate_total": heat_snap["rate_total"],
+    }
+    out["costs"] = {
+        label: {
+            "mfu": row["mfu"],
+            "flops_per_row": row["flops_per_row"],
+            "pad_waste_score": row["pad_waste_score"],
+        }
+        for label, row in cost.snapshot()["buckets"].items()
+    }
     # fleet-train side (process default registry): program-build counts
     # recorded by FleetTrainer during phase 2 — present and bounded (a
     # recompile storm at 10k members would show up as builds >> buckets)
