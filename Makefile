@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history gameday heat hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo gameday-demo capacity-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history gameday heat qos hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo gameday-demo capacity-demo qos-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -142,6 +142,20 @@ gameday:
 heat:
 	$(PYTHON) -m pytest tests/ -q -m heat --continue-on-collection-errors
 
+# QoS lane: the multi-tenant fairness stack — request classification
+# (headers + __meta__ sidecar, alias/sanitize/cardinality rules), the
+# per-tenant token buckets and the three admission rules (tenant_rate /
+# queue_pressure / goodput_burn, each with an honest Retry-After), the
+# weighted-fair queue's starvation bound + class-aware deadline order,
+# per-class metric plumbing end to end (render -> parse -> watchman
+# rollup, unknown tenants collapsed to `other`), and the noisy-neighbor
+# acceptance on BOTH the JSON and binary tensor paths: a best_effort
+# flood at 5x capacity must leave interactive goodput >=0.95, land
+# >=90% of sheds on the flooding class, and never 429 the interactive
+# probe (tests/test_qos.py)
+qos:
+	$(PYTHON) -m pytest tests/ -q -m qos --continue-on-collection-errors
+
 # hot-loop overhead lane: every disabled-instrumentation guard in one
 # named check (metrics recording, disarmed faultpoints, tracing) — a
 # regression that makes "off" cost >5% on the serving loop fails HERE,
@@ -250,6 +264,14 @@ gameday-demo:
 # (tools/capacity_demo.py; bench.py's `heat_cost` leg runs the same tool)
 capacity-demo:
 	$(PYTHON) tools/capacity_demo.py
+
+# best_effort flood vs a steady interactive probe through the real
+# serving stack (admission + weighted-fair engine + per-class SLO);
+# prints the per-class fairness table (admitted/shed, WFQ dequeues,
+# per-tenant goodput + burn) + one JSON doc (tools/qos_demo.py;
+# bench.py's `qos` leg runs the same tool)
+qos-demo:
+	$(PYTHON) tools/qos_demo.py
 
 bench:
 	$(PYTHON) bench.py

@@ -1230,6 +1230,52 @@ def bench_gameday(scenarios=None, members=4):
     }
 
 
+def bench_qos(flood_workers=10, flood_seconds=8.0, baseline=40):
+    """Multi-tenant QoS fairness (ISSUE 19) — a best_effort flood
+    (tenant ``flood``, token-bucket limited) against a steady
+    interactive probe through the real admission + weighted-fair
+    batching stack. Subprocess via tools/qos_demo.py (the child must
+    set its QoS/SLO env before jax imports). Records the fairness
+    headline numbers: interactive p99 under flood vs unloaded,
+    per-class goodput ratios, and shed precision (the fraction of
+    admission sheds that landed on the flooding class)."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "qos_demo.py"
+    )
+    cmd = [
+        sys.executable, tool,
+        "--flood-workers", str(flood_workers),
+        "--flood-seconds", str(flood_seconds),
+        "--baseline", str(baseline),
+    ]
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=STALL_SECONDS,
+        env=dict(os.environ),
+    )
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    try:
+        doc = json.loads(lines[-1])
+    except (IndexError, json.JSONDecodeError):
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        raise RuntimeError(f"qos demo failed: {' | '.join(tail[-3:])}")
+    # structural acceptance: interactive stays clean while the flood is
+    # shed precisely — the noisy neighbor pays, the quiet one does not
+    assert out.returncode == 0, doc
+    assert doc["interactive_non_200"] == 0, doc
+    precision = doc["shed_precision"]
+    assert precision is None or precision >= 0.9, doc
+    return {
+        "qos_interactive_p99_flood_ms": doc["interactive_p99_flood_ms"],
+        "qos_interactive_p99_ratio": doc["interactive_p99_ratio"],
+        "qos_interactive_non_200": doc["interactive_non_200"],
+        "qos_shed_total": doc["shed_total"],
+        "qos_shed_precision": precision,
+        "qos_goodput_ratio_interactive": doc["goodput_ratio_interactive"],
+        "qos_goodput_ratio_best_effort": doc["goodput_ratio_best_effort"],
+        "qos": doc,
+    }
+
+
 def bench_bank_sequence(n_models=16, n_features=10, rows=256, iters=10):
     """Config 5 extension — sequence models served from the HBM bank
     (windowing runs in-graph with the bucket's static lookback)."""
@@ -1773,6 +1819,7 @@ METRICS = (
     ("serving_saturation", bench_serving_saturation),
     ("mesh_serving", bench_mesh_serving),
     ("gameday", bench_gameday),
+    ("qos", bench_qos),
     ("model_zoo", bench_sequence_models),
     ("checkpoint", bench_checkpoint_overhead),
     ("host_pipeline", bench_host_pipeline),
@@ -1815,6 +1862,7 @@ CPU_KWARGS = {
             "migration_storm",
         ),
     ),
+    "qos": dict(flood_workers=6, flood_seconds=5.0, baseline=25),
     "host_pipeline": dict(n_members=64),
     "client_bulk": dict(n_models=4, rows=1000),
     # the full 10k leg takes ~2.5 min on one core (measured; most of it

@@ -114,8 +114,10 @@ class Client:
         retries: int = 3,
         backoff: float = 0.5,
         retry_budget: Optional[RetryBudget] = None,
-        retry_budget_ratio: float = 0.1,
+        retry_budget_ratio: Optional[float] = None,
         deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
         hedge: bool = False,
         replica_urls: Optional[List[str]] = None,
         hedge_delay_init_s: float = 1.0,
@@ -132,6 +134,22 @@ class Client:
         self.forwarder = forwarder
         self.use_anomaly = use_anomaly
         self.metadata_fallback_dataset = metadata_fallback_dataset
+        # multi-tenant QoS identity (qos/classify.py): stamped on every
+        # scoring POST as X-Gordo-Tenant / X-Gordo-Priority and, on the
+        # binary/shm paths, in the __meta__ tensor sidecar — proxies may
+        # strip custom headers and shm envelopes never had any. The
+        # class also picks the client's own overload posture below
+        # (retry ratio, hedging): a best-effort client must not amplify
+        # the very overload that is shedding it.
+        from gordo_components_tpu.qos.classify import (
+            normalize_class,
+            normalize_tenant,
+        )
+
+        self.tenant = normalize_tenant(tenant) if tenant else None
+        self.qos_class = (
+            normalize_class(priority) if priority else "interactive"
+        )
         # transport citizenship knobs (previously hardcoded in io.py):
         # bounded retries with decorrelated-jitter backoff, all gated by
         # ONE shared token-bucket retry budget — a thousand chunks
@@ -139,6 +157,13 @@ class Client:
         # load, not 3x (the synchronized-retry overload recipe)
         self.retries = int(retries)
         self.backoff = float(backoff)
+        if retry_budget_ratio is None:
+            # per-class retry appetite: lower classes re-offer less of
+            # their failed load — they are the first to be shed, so
+            # their retries are the likeliest to be pure overload fuel
+            retry_budget_ratio = {
+                "batch": 0.05, "best_effort": 0.02
+            }.get(self.qos_class, 0.1)
         self.retry_budget = (
             retry_budget
             if retry_budget is not None
@@ -150,8 +175,11 @@ class Client:
         self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         # tail-latency hedging: after a p95-derived delay, re-issue a
         # slow chunk POST to one other replica (from watchman's target
-        # list — see replicas_from_watchman) and take the first success
-        self.hedge = bool(hedge)
+        # list — see replicas_from_watchman) and take the first success.
+        # best_effort NEVER hedges: a hedge is a second copy of the load
+        # the fleet is most willing to shed, and tail latency is not
+        # part of that class's contract anyway.
+        self.hedge = bool(hedge) and self.qos_class != "best_effort"
         self.replica_urls = [
             u.rstrip("/") for u in (replica_urls or []) if u.rstrip("/")
         ]
@@ -579,20 +607,29 @@ class Client:
                 urls.append(f"{alt}/{path}")
         return urls
 
-    @staticmethod
-    def _trace_headers(rid: str) -> Dict[str, str]:
+    def _trace_headers(self, rid: str) -> Dict[str, str]:
         """Scoring-POST id headers: the gordo request id plus a W3C
         ``traceparent`` whose trace id is DERIVED from the request id
         (md5 — identity, not security), so a client log line and the
         server-side trace are the same identifier family and either one
         recovers the other. The sampled flag is set: a request the
         client bothered to stamp is one the operator wants retrievable
-        at ``GET .../traces`` regardless of server head sampling."""
+        at ``GET .../traces`` regardless of server head sampling.
+
+        The QoS identity rides here too (when configured): the server's
+        middleware classifies every scoring request from these headers,
+        so one header pair covers the JSON, parquet, and tensor-over-
+        HTTP encodings alike."""
         trace_id = hashlib.md5(rid.encode()).hexdigest()
-        return {
+        headers = {
             "X-Gordo-Request-Id": rid,
             "traceparent": format_traceparent(trace_id, trace_id[:16]),
         }
+        if self.tenant:
+            headers["X-Gordo-Tenant"] = self.tenant
+        if self.qos_class != "interactive":
+            headers["X-Gordo-Priority"] = self.qos_class
+        return headers
 
     # ------------------------------------------------------------------ #
     # local zero-copy transports (docs/architecture.md "Serving
@@ -1029,18 +1066,35 @@ class Client:
         self._note_wire("parquet", len(body), len(chunk))
         return resp
 
-    @staticmethod
-    def _encode_tensor(chunk: pd.DataFrame, chunk_y) -> bytes:
+    def _encode_tensor(self, chunk: pd.DataFrame, chunk_y) -> bytes:
         """One chunk as a framed tensor body (utils/wire.py): the float32
         rows in C order, one memory copy total. Runs on an executor
         thread so chunk k+1 serializes while chunk k's POST is in flight
         (with tensor framing the encode is ~µs — the executor hop is for
-        symmetry with the other encoders and for very large chunks)."""
+        symmetry with the other encoders and for very large chunks).
+
+        When a QoS identity is configured it rides in a ``__meta__``
+        sidecar frame (JSON bytes): the shm ring has no headers and
+        proxies may strip custom ones, so the framed body itself must
+        carry tenant + priority for fairness to hold on every
+        transport."""
         frames = [("X", np.ascontiguousarray(chunk.values, dtype=np.float32))]
         if chunk_y is not None:
             frames.append(
                 ("y", np.ascontiguousarray(chunk_y.values, dtype=np.float32))
             )
+        meta: Dict[str, str] = {}
+        if self.tenant:
+            meta["tenant"] = self.tenant
+        if self.qos_class != "interactive":
+            meta["priority"] = self.qos_class
+        if meta:
+            frames.append((
+                "__meta__",
+                np.frombuffer(
+                    json.dumps(meta).encode("utf-8"), dtype=np.uint8
+                ),
+            ))
         return pack_frames(frames)
 
     def _decode_tensor_scoring_body(

@@ -15,7 +15,11 @@ catalog has a gate-mode drill here, run through public surfaces only:
 - ``gray_failure_slow_replica`` gate-mode: a probe window over the
   live replica, judged by its OWN ``/slo`` fast-burn state — a canary
   that answers but burns its latency budget is not a promotable
-  canary.
+  canary;
+- ``tenant_noisy_neighbor`` gate-mode: a best_effort-tagged scoring
+  flood against the canary while interactive probes run, judged from
+  ``GET /qos`` counter deltas — the flood must classify, sheds must
+  land on it, and the probes must stay all-200.
 
 Verdicts use the shared envelope (``replay/verdict.py``), so the fleet
 report, BENCH_DETAIL and the full harness all read the same way. The
@@ -260,9 +264,128 @@ def _gate_latency_burn_probe(ctx: _GateContext):
     return verdict, fails
 
 
+def _gate_qos_fairness(ctx: _GateContext):
+    """The noisy-neighbor scenario's shippable failure mode: a rollout
+    that breaks classification or admission, so a best_effort flood
+    hurts interactive traffic — or the QoS surface itself vanished.
+    Drill: flood the canary's scoring endpoint with best_effort-tagged
+    requests (valid bodies, widths from GET /qos ``feature_widths``)
+    while the probe + the caller's real traffic hook keep running;
+    judge from the GET /qos counter DELTAS — the flood must classify
+    as best_effort, any admission sheds must land on it, and the probe
+    window must stay all-200."""
+    import json as _json
+
+    import requests
+
+    qos0 = ctx.get_json("qos")
+    widths = (qos0.get("engine") or {}).get("feature_widths") or {}
+    fails: List[str] = []
+    verdict: Dict[str, Any] = {
+        "gate_mode": "qos_fairness_flood",
+        "injected": "best_effort-tagged scoring flood against the "
+        "canary while interactive probes run",
+        "detected": bool(qos0.get("enabled")),
+    }
+    if not qos0.get("enabled") or not widths:
+        fails.append(
+            "GET /qos unavailable or no banked targets to flood "
+            f"(enabled={qos0.get('enabled')}, widths={len(widths)})"
+        )
+        verdict["non_200"] = 0
+        return verdict, fails
+    target, width = sorted(widths.items())[0]
+    flood_statuses: Dict[str, int] = {}
+    stop = threading.Event()
+
+    def flood() -> None:
+        sess = requests.Session()
+        url = (
+            f"{ctx.base_url}/gordo/v0/{ctx.project}/{target}/prediction"
+        )
+        body = _json.dumps({"X": [[0.5] * width] * 8})
+        headers = {
+            "Content-Type": "application/json",
+            "X-Gordo-Tenant": "gate-flood",
+            "X-Gordo-Priority": "best_effort",
+        }
+        while not stop.is_set():
+            try:
+                resp = sess.post(
+                    url, data=body, headers=headers,
+                    timeout=ctx.http_timeout,
+                )
+                key = str(resp.status_code)
+            except Exception:
+                key = "599"
+            flood_statuses[key] = flood_statuses.get(key, 0) + 1
+
+    threads = [
+        threading.Thread(target=flood, daemon=True) for _ in range(4)
+    ]
+    with _Probe(
+        ctx.base_url, ctx.project, ctx.traffic,
+        http_timeout=ctx.http_timeout,
+    ) as probe:
+        for t in threads:
+            t.start()
+        time.sleep(max(ctx.settle_s * 2, 1.5))
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+    qos1 = ctx.get_json("qos")
+
+    def _sum(doc, section, want_class=None):
+        cells = (doc.get("admission") or {}).get(section) or {}
+        return sum(
+            n for key, n in cells.items()
+            if want_class is None or key.split("|")[1:2] == [want_class]
+        )
+
+    admitted_be = _sum(qos1, "admitted", "best_effort") - _sum(
+        qos0, "admitted", "best_effort"
+    )
+    shed_all = _sum(qos1, "shed") - _sum(qos0, "shed")
+    shed_be = _sum(qos1, "shed", "best_effort") - _sum(
+        qos0, "shed", "best_effort"
+    )
+    precision = round(shed_be / shed_all, 4) if shed_all > 0 else None
+    verdict.update(
+        {
+            "flood_target": target,
+            "flood_statuses": flood_statuses,
+            "non_200": probe.non_200 + probe.traffic_errors,
+            "probe_requests": probe.requests,
+            "probe_statuses": probe.statuses,
+            "probe_p95_ms": probe.p95_ms(),
+            "best_effort_admitted_delta": admitted_be,
+            "shed_delta": shed_all,
+            "shed_on_best_effort_delta": shed_be,
+            "shed_precision": precision,
+        }
+    )
+    if admitted_be + shed_be <= 0:
+        fails.append(
+            "the best_effort flood never classified (admitted + shed "
+            "deltas are zero): the QoS request path is broken"
+        )
+    if precision is not None and precision < 0.9:
+        fails.append(
+            f"shed precision {precision} < 0.9: admission shed "
+            "traffic outside the flooding class"
+        )
+    if verdict["non_200"]:
+        fails.append(
+            f"{verdict['non_200']} interactive non-200(s) during the "
+            f"flood window (budget 0; statuses: {probe.statuses})"
+        )
+    return verdict, fails
+
+
 _GATE_DRILLS = {
     "replica_crash_restart": _gate_reload_under_load,
     "gray_failure_slow_replica": _gate_latency_burn_probe,
+    "tenant_noisy_neighbor": _gate_qos_fairness,
 }
 
 

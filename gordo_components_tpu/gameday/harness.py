@@ -31,7 +31,7 @@ import socket
 import subprocess
 import sys
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from gordo_components_tpu.gameday.scenarios import SCENARIOS
 
@@ -49,7 +49,7 @@ N_FEATURES = 8
 GAMEDAY_SCHEMA = "gordo.gameday-run/v1"
 # the mesh shapes in boot order: every scenario declares which one it
 # needs, and run_gameday boots each shape at most once per run
-SHAPE_ORDER = ("partitioned", "replicated", "push", "streaming")
+SHAPE_ORDER = ("partitioned", "replicated", "qos", "push", "streaming")
 
 
 def free_port() -> int:
@@ -720,6 +720,180 @@ async def _run_gray_failure(mesh: GamedayMesh) -> Dict[str, Any]:
     }
 
 
+async def _run_tenant_noisy_neighbor(mesh: GamedayMesh) -> Dict[str, Any]:
+    """best_effort flood vs steady interactive probes on the qos mesh.
+
+    Phases: (1) unloaded interactive baseline -> p99; (2) flood: N
+    concurrent best_effort workers per replica (tenant ``flood``, rate-
+    limited by GORDO_QOS_TENANTS and depth-limited by the per-class
+    shed fractions) while the SAME interactive probe keeps scoring and
+    the watchman per-class rollup is polled for the flood class's burn;
+    (3) evidence: per-replica GET /qos sheds -> precision, probe
+    latencies -> p99 ratio, probe statuses -> non_200."""
+    import aiohttp
+
+    from gordo_components_tpu.utils.wire import TENSOR_CONTENT_TYPE
+
+    member = mesh.members[0]
+    body = scoring_body(rows=16)
+    flood_body = scoring_body(rows=32, seed=2)
+    flood_headers = {
+        "Content-Type": TENSOR_CONTENT_TYPE,
+        "X-Gordo-Tenant": "flood",
+        "X-Gordo-Priority": "best_effort",
+    }
+    probe_headers = {"Content-Type": TENSOR_CONTENT_TYPE}
+
+    async def probe_once(session, base) -> Tuple[int, float]:
+        t0 = time.monotonic()
+        try:
+            async with session.post(
+                mesh.score_url(base, member), data=body,
+                headers=probe_headers,
+            ) as resp:
+                await resp.read()
+                return resp.status, time.monotonic() - t0
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return 599, time.monotonic() - t0
+
+    def p99(samples: List[float]) -> Optional[float]:
+        if not samples:
+            return None
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    flood_statuses: Dict[str, int] = {}
+    stop = asyncio.Event()
+
+    async def flood_worker(session, base) -> None:
+        while not stop.is_set():
+            try:
+                async with session.post(
+                    mesh.score_url(base, member), data=flood_body,
+                    headers=flood_headers,
+                ) as resp:
+                    await resp.read()
+                    key = str(resp.status)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                key = "599"
+            flood_statuses[key] = flood_statuses.get(key, 0) + 1
+
+    timeout = aiohttp.ClientTimeout(total=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # -------- baseline: unloaded interactive p99 ------------------ #
+        base_lat: List[float] = []
+        errors: List[str] = []
+        for i in range(40):
+            status, dt = await probe_once(
+                session, mesh.base_urls[i % mesh.n_replicas]
+            )
+            if status == 200:
+                base_lat.append(dt)
+            else:
+                errors.append(f"baseline probe {status}")
+        # -------- flood phase ----------------------------------------- #
+        workers = [
+            asyncio.get_running_loop().create_task(
+                flood_worker(session, base)
+            )
+            for base in mesh.base_urls
+            for _ in range(12)
+        ]
+        flood_lat: List[float] = []
+        probe_statuses: Dict[str, int] = {}
+        non_200 = 0
+        class_burn_peak = None
+        deadline = time.monotonic() + 15.0
+        i = 0
+        try:
+            while time.monotonic() < deadline:
+                status, dt = await probe_once(
+                    session, mesh.base_urls[i % mesh.n_replicas]
+                )
+                i += 1
+                probe_statuses[str(status)] = (
+                    probe_statuses.get(str(status), 0) + 1
+                )
+                if status == 200:
+                    flood_lat.append(dt)
+                else:
+                    non_200 += 1
+                if i % 4 == 0:
+                    slo = await mesh.wm_json(
+                        "/slo", params={"refresh": "1"}
+                    )
+                    entry = (slo.get("classes") or {}).get(
+                        "flood|best_effort"
+                    )
+                    for w in (entry or {}).get("windows", {}).values():
+                        burn = w.get("burn_rate")
+                        if burn is not None and (
+                            class_burn_peak is None
+                            or burn > class_burn_peak
+                        ):
+                            class_burn_peak = burn
+        finally:
+            stop.set()
+            await asyncio.gather(*workers, return_exceptions=True)
+        # -------- evidence: admission sheds per replica --------------- #
+        shed_total = 0
+        shed_flood_class = 0
+        for base in mesh.base_urls:
+            url = f"{base}/gordo/v0/{mesh.project}/qos"
+            async with session.get(url) as resp:
+                qos_doc = await resp.json()
+            for key, n in (
+                (qos_doc.get("admission") or {}).get("shed") or {}
+            ).items():
+                shed_total += n
+                # key is "tenant|class|reason"
+                if key.split("|")[1:2] == ["best_effort"]:
+                    shed_flood_class += n
+    p99_base = p99(base_lat)
+    p99_flood = p99(flood_lat)
+    ratio = (
+        round(p99_flood / p99_base, 3)
+        if p99_base and p99_flood
+        else None
+    )
+    precision = (
+        round(shed_flood_class / shed_total, 4) if shed_total else None
+    )
+    return {
+        "injected": (
+            f"best_effort flood (tenant=flood, {12 * mesh.n_replicas} "
+            "workers) against a steady interactive probe"
+        ),
+        "detected": shed_total > 0,
+        "detection_signal": "admission sheds on GET /qos + per-class "
+        "burn on the watchman /slo rollup",
+        "non_200": non_200 + len(errors),
+        "statuses": {
+            "interactive": probe_statuses,
+            "flood": flood_statuses,
+            "errors": errors[:5],
+        },
+        "interactive_p99_baseline_s": p99_base,
+        "interactive_p99_flood_s": p99_flood,
+        "interactive_p99_ratio": ratio,
+        "interactive_requests": len(base_lat) + sum(
+            probe_statuses.values()
+        ),
+        "flood_requests": sum(flood_statuses.values()),
+        "shed_total": shed_total,
+        "shed_on_flood_class": shed_flood_class,
+        "shed_precision": precision,
+        "class_burn_peak": class_burn_peak,
+        # the flood is the declared blast radius; nothing to heal
+        "recovered": True,
+        "recovery_s": 0.0,
+    }
+
+
 async def _run_thundering_herd(mesh: GamedayMesh) -> Dict[str, Any]:
     import aiohttp
 
@@ -961,6 +1135,7 @@ RUNNERS: Dict[str, Callable[[GamedayMesh], Any]] = {
     "watchman_partition": _run_watchman_partition,
     "migration_storm": _run_migration_storm,
     "gray_failure_slow_replica": _run_gray_failure,
+    "tenant_noisy_neighbor": _run_tenant_noisy_neighbor,
     "thundering_herd": _run_thundering_herd,
     "correlated_drift": _run_correlated_drift,
 }
@@ -994,6 +1169,27 @@ def _mesh_for(shape: str, root: str, members: List[str]) -> GamedayMesh:
             },
             replica_env={
                 1: {"GORDO_FAULTS": "engine.queue=latency:0.25,times=60"},
+            },
+        )
+    if shape == "qos":
+        # the noisy-neighbor drill: clean replicas (no armed faults — a
+        # latency fault would pollute the p99 baseline), a tight engine
+        # queue so a flood reaches the per-class shed thresholds within
+        # seconds, fast per-class SLO windows, and a named flood tenant
+        # so its metric label survives the cardinality bound
+        return GamedayMesh(
+            root, members, n_replicas=2, partitioned=False,
+            refresh_interval=0.5,
+            common_env={
+                "GORDO_SLO_SAMPLE_S": "0.2",
+                "GORDO_SLO_WINDOWS": "30s,5m",
+                "GORDO_SLO_OBJECTIVES": json.dumps([
+                    {"name": "availability", "target": 0.999},
+                ]),
+                "GORDO_BANK_MAX_QUEUE": "32",
+                "GORDO_QOS_TENANTS": json.dumps(
+                    {"flood": {"rate": 60.0, "burst": 90.0}}
+                ),
             },
         )
     if shape == "push":

@@ -36,7 +36,17 @@ Bounds vocabulary (all optional):
 - ``require_all_subscribers_recovered`` — every push subscriber polled
   successfully again after the blip;
 - ``min_burn_peak`` — the SLO burn must actually have peaked at or
-  above this (the failure was visible, not theoretical).
+  above this (the failure was visible, not theoretical);
+- ``min_shed_precision`` — of everything admission shed during the
+  drill, at least this fraction must have landed on the flooding class
+  (vacuously 1.0 when nothing was shed — no sheds means nobody was
+  mis-shed);
+- ``min_class_burn_peak`` — the flooding class's own
+  ``gordo_slo_burn_rate{class}`` must have peaked at or above this on
+  the fleet rollup (the shed was goodput-driven, not just queue luck);
+- ``max_interactive_p99_ratio`` — interactive p99 under flood over
+  unloaded interactive p99 stays at or under this (the fairness
+  headline number).
 
 Load-level bounds that only hold with real parallelism go in
 ``multicore_bounds`` — the judge merges them only when the host has >=2
@@ -65,7 +75,7 @@ __all__ = [
 class GamedayScenario:
     name: str
     description: str
-    mesh: str  # mesh shape the drill needs: partitioned|replicated|push|streaming
+    mesh: str  # shape the drill needs: partitioned|replicated|push|streaming|qos
     bounds: Dict[str, Any] = field(default_factory=dict)
     multicore_bounds: Dict[str, Any] = field(default_factory=dict)
     # gate-capable scenarios have a bounded single-replica drill
@@ -178,6 +188,43 @@ class GamedayScenario:
                 f"burn peak {verdict.get('burn_peak')} < {min_bp} "
                 "(the failure never showed on the SLO surface)"
             )
+        min_sp = b.pop("min_shed_precision", None)
+        if min_sp is not None:
+            # vacuous pass at 1.0: zero sheds means zero MIS-sheds —
+            # the bound is about who got hit, not whether anyone did
+            prec = verdict.get("shed_precision")
+            if prec is None:
+                prec = 1.0
+            if prec < min_sp:
+                fails.append(
+                    f"shed precision {prec:.3f} < {min_sp} (sheds "
+                    "landed on the wrong class — fairness failed)"
+                )
+        min_cbp = b.pop("min_class_burn_peak", None)
+        if min_cbp is not None and (
+            verdict.get("class_burn_peak") is None
+            or verdict["class_burn_peak"] < min_cbp
+        ):
+            fails.append(
+                f"flooding-class burn peak "
+                f"{verdict.get('class_burn_peak')} < {min_cbp} "
+                "(the flood never burned its own class budget — "
+                "shed was not goodput-attributed)"
+            )
+        max_ipr = b.pop("max_interactive_p99_ratio", None)
+        if max_ipr is not None:
+            ratio = verdict.get("interactive_p99_ratio")
+            if ratio is None:
+                fails.append(
+                    "interactive p99 ratio was never measured "
+                    "(baseline or flood phase produced no latencies)"
+                )
+            elif ratio > max_ipr:
+                fails.append(
+                    f"interactive p99 under flood = {ratio:.2f}x "
+                    f"unloaded > {max_ipr}x (the flood starved "
+                    "interactive latency)"
+                )
         if b:
             fails.append(f"unknown bounds: {sorted(b)}")
         return fails
@@ -307,6 +354,37 @@ SCENARIOS: Dict[str, GamedayScenario] = {
                 ],
                 "min_routing_version_steps": 2,
             },
+        ),
+        GamedayScenario(
+            name="tenant_noisy_neighbor",
+            description=(
+                "A best_effort tenant floods the replicated fleet while "
+                "a steady interactive client keeps scoring: weighted-"
+                "fair batching + per-class admission must keep every "
+                "interactive prediction 200 with bounded p99, land "
+                ">=90% of the sheds on the flooding class, and show the "
+                "flood burning its OWN class budget on the watchman "
+                "per-class rollup — the noisy neighbor pays, the quiet "
+                "one does not."
+            ),
+            # own mesh shape: the replicated shape arms a latency fault
+            # for the gray-failure drill, which would pollute this
+            # scenario's p99 baseline; qos boots clean with a tight
+            # engine queue + per-class SLO windows instead
+            mesh="qos",
+            bounds={
+                # interactive traffic only — the flood is EXPECTED to
+                # eat 429s, so its non-200s are excluded by the runner
+                "max_non200": 0,
+                "min_shed_precision": 0.9,
+            },
+            multicore_bounds={
+                # latency-fairness and burn-visibility bounds only hold
+                # when the flood and the probe truly run concurrently
+                "max_interactive_p99_ratio": 1.5,
+                "min_class_burn_peak": 1.0,
+            },
+            gate_capable=True,
         ),
         GamedayScenario(
             name="correlated_drift",

@@ -63,6 +63,10 @@ STAGES = ("queue_wait", "coalesce", "pad", "device_execute", "postprocess")
 
 _ENV_ENABLE = "GORDO_SLO"
 
+# tenant_cells list layout: [goodput, wasted, expired]
+_TENANT_IDX = {"goodput": 0, "wasted": 1, "expired": 2}
+_TENANT_OUTCOMES = ("goodput", "wasted", "expired")
+
 
 class GoodputLedger:
     """Cumulative goodput/waste/overhead accounting for one serving app.
@@ -92,6 +96,14 @@ class GoodputLedger:
         # ms-scale deadline budgets live where coarse bins blur
         # percentiles (same resolution as server/stats.LatencyHistogram).
         self.latency = Histogram(bins_per_decade=LATENCY_BINS_PER_DECADE)
+        # ---- per-(tenant, priority-class) cells (ISSUE 19) ----
+        # (tenant_label, qos_class) -> [goodput, wasted, expired].
+        # Callers pass the cardinality-BOUNDED tenant label (known
+        # tenants + "default" + "other" — qos/classify.py), so the dict
+        # stays O(tenants x 3); the 256-key cap below is defense in
+        # depth for direct callers that skip classification, matching
+        # the PR 18 registry guard's never-unbounded rule.
+        self.tenant_cells: Dict[Tuple[str, str], List[int]] = {}
         self._stage_queue_wait_s = 0.0
         # ---- scoring-executor cells (account_group) ----
         self.device_padded_s = 0.0  # device window spent on pad rows
@@ -180,6 +192,8 @@ class GoodputLedger:
         elapsed_s: float = 0.0,
         device_s: float = 0.0,
         scores_finite: bool = True,
+        tenant: str = "default",
+        qos_class: str = "interactive",
     ) -> None:
         """Classify one finished scoring request (event loop; the server
         middleware calls this — bench/north-star drive it directly).
@@ -188,7 +202,10 @@ class GoodputLedger:
         deadline ran out — before dispatch the common case, after
         dispatch when a mid-pipeline expiry discarded the group).
         wasted: everything else (5xx, shed 429s, quarantine 410s, bad
-        input 4xxs, non-finite output behind a 200)."""
+        input 4xxs, non-finite output behind a 200). ``tenant`` /
+        ``qos_class`` additionally attribute the outcome to the
+        request's QoS identity (qos/classify.py; tenant must be the
+        bounded label)."""
         if status == 504:
             cls = "expired"
         elif status < 400 and scores_finite:
@@ -196,6 +213,15 @@ class GoodputLedger:
         else:
             cls = "wasted"
         self.requests[cls] += 1
+        key = (tenant, qos_class)
+        cell = self.tenant_cells.get(key)
+        if cell is None:
+            if len(self.tenant_cells) >= 256 and key not in self.tenant_cells:
+                key = ("other", qos_class)
+                cell = self.tenant_cells.get(key)
+            if cell is None:
+                cell = self.tenant_cells[key] = [0, 0, 0]
+        cell[_TENANT_IDX[cls]] += 1
         if status >= 500 or (status < 400 and not scores_finite):
             self.errors_5xx += 1
         if status < 400:
@@ -268,6 +294,14 @@ class GoodputLedger:
                 ),
             },
             "stages_s": {k: round(v, 6) for k, v in sorted(stages.items())},
+            # per-(tenant, class) outcome counts, "tenant|class" keyed
+            # (same atomic-snapshot idiom as per_bucket below)
+            "tenants": {
+                f"{tenant}|{cls}": dict(zip(_TENANT_OUTCOMES, cell))
+                for (tenant, cls), cell in sorted(
+                    list(self.tenant_cells.items())
+                )
+            },
             "latency": self.latency.snapshot(),
             # list() first: the scoring executor inserts a first-seen
             # bucket/shard key mid-read; snapshot the dict atomically
@@ -325,6 +359,17 @@ class GoodputLedger:
                 "gordo_goodput_requests_total", "counter",
                 "Scoring requests by goodput class", {"class": cls}, n,
             )
+        # per-(tenant, priority-class) outcomes (ISSUE 19): a separate
+        # family — "class" here is the PRIORITY class; the outcome gets
+        # its own label so it can't collide with the family above
+        for (tenant, cls), cell in sorted(list(self.tenant_cells.items())):
+            for outcome, n in zip(_TENANT_OUTCOMES, cell):
+                yield (
+                    "gordo_goodput_tenant_requests_total", "counter",
+                    "Scoring requests by tenant, priority class, and "
+                    "goodput outcome",
+                    {"tenant": tenant, "class": cls, "outcome": outcome}, n,
+                )
         for cls, v in (
             ("goodput", self.device_goodput_s),
             ("wasted", self.device_wasted_s + self.device_failed_s),

@@ -236,6 +236,16 @@ class SLOTracker:
         )
         self._lock = threading.Lock()
         self._cached: Optional[Dict[str, Any]] = None
+        # per-(tenant, priority-class) burn uses ONE budget: the
+        # availability objective's when configured (per-class burn is an
+        # availability-style "share of requests that weren't goodput"),
+        # 0.001 otherwise — per-class latency/goodput-second objectives
+        # would need per-class histograms the ledger deliberately
+        # doesn't keep (cardinality)
+        self._class_budget = next(
+            (o.budget for o in self.objectives if o.name == "availability"),
+            0.001,
+        )
         if registry is not None:
             registry.collector(self._collect, key="slo")
 
@@ -261,6 +271,17 @@ class SLOTracker:
                 sample[f"le:{obj.name}"] = float(
                     led.latency.count_le(obj.target / 1e3)
                 )
+        # per-(tenant, priority-class) cells (ISSUE 19) ride in the same
+        # flat sample as "tc:<tenant>|<class>:good/:total" keys, so
+        # _window_delta's generic subtraction windows them for free (a
+        # key first seen mid-ring deltas against 0 — correct for
+        # monotonic counters). Bounded: the ledger bounds tenant labels.
+        cells = getattr(led, "tenant_cells", None)
+        if cells:
+            for (tenant, cls), cell in sorted(list(cells.items())):
+                key = f"tc:{tenant}|{cls}"
+                sample[f"{key}:good"] = float(cell[0])
+                sample[f"{key}:total"] = float(cell[0] + cell[1] + cell[2])
         return sample
 
     def sample(self, now: Optional[float] = None, force: bool = False) -> bool:
@@ -394,8 +415,79 @@ class SLOTracker:
                 "windows": {name: sec for name, sec in self.windows},
                 "objectives": objectives,
                 "worst": worst,
+                "classes": self._class_windows(),
             }
             return self._cached
+
+    def _class_windows(self) -> Dict[str, Any]:
+        """Per-(tenant, priority-class) windowed burn (lock held).
+        Availability-style: good = goodput-classified requests, total =
+        all classified, burn = (1 - ratio) / class budget."""
+        latest = self._samples[-1] if self._samples else {}
+        keys = sorted(
+            k[3:-5]
+            for k in latest
+            if k.startswith("tc:") and k.endswith(":good")
+        )
+        if not keys:
+            return {}
+        # one delta per window, shared across every class key (the
+        # objectives path recomputes per objective; class keys can be
+        # tenants x classes wide, so share the subtraction here)
+        deltas = {wname: self._window_delta(wsec) for wname, wsec in self.windows}
+        fast_window = self.windows[0][0]
+        out: Dict[str, Any] = {}
+        for key in keys:
+            windows: Dict[str, Any] = {}
+            for wname, _wsec in self.windows:
+                got = deltas[wname]
+                if got is None:
+                    windows[wname] = {
+                        "window_s": 0.0, "good": 0.0, "total": 0.0,
+                        "ratio": None, "burn_rate": 0.0,
+                    }
+                    continue
+                delta, actual = got
+                good = delta.get(f"tc:{key}:good", 0.0)
+                total = delta.get(f"tc:{key}:total", 0.0)
+                if total <= 0:
+                    ratio, burn = None, 0.0
+                else:
+                    ratio = good / total
+                    burn = max(0.0, 1.0 - ratio) / self._class_budget
+                windows[wname] = {
+                    "window_s": round(actual, 3),
+                    "good": round(good, 6),
+                    "total": round(total, 6),
+                    "ratio": None if ratio is None else round(ratio, 6),
+                    "burn_rate": round(burn, 4),
+                }
+            fast = windows[fast_window]["burn_rate"]
+            out[key] = {
+                "budget": round(self._class_budget, 6),
+                "windows": windows,
+                "fast_burn": bool(fast >= self.fast_burn_threshold),
+            }
+        return out
+
+    def class_burn(self, qos_class: str) -> Optional[float]:
+        """Fast-window burn for one priority class, summed across
+        tenants — the admission controller's goodput-shed signal
+        (qos/admission.py). None when the class served nothing in the
+        window (no evidence is not a burn)."""
+        snap = self.snapshot()
+        fast_window = self.windows[0][0]
+        good = total = 0.0
+        for key, entry in snap.get("classes", {}).items():
+            if key.rsplit("|", 1)[-1] != qos_class:
+                continue
+            w = entry["windows"].get(fast_window)
+            if w:
+                good += w["good"]
+                total += w["total"]
+        if total <= 0:
+            return None
+        return max(0.0, 1.0 - good / total) / self._class_budget
 
     def _collect(self):
         """Registry gauges from the SAME cached snapshot ``/slo`` serves
@@ -417,6 +509,20 @@ class SLOTracker:
                         {"objective": obj["name"], "window": wname},
                         w["ratio"],
                     )
+        # per-(tenant, class) burn in the SAME family — alerting joins
+        # "which objective is burning" with "whose traffic is burning it"
+        # on one metric name. Tenant labels were bounded at classification
+        # time (qos/classify.py), so this block cannot explode series.
+        for key, entry in snap.get("classes", {}).items():
+            tenant, _, qos_class = key.rpartition("|")
+            for wname, w in entry["windows"].items():
+                yield (
+                    "gordo_slo_burn_rate", "gauge",
+                    "Error-budget burn rate per objective and window "
+                    "(1.0 = burning exactly at budget)",
+                    {"tenant": tenant, "class": qos_class, "window": wname},
+                    w["burn_rate"],
+                )
 
 
 # ---------------------------------------------------------------------- #
@@ -440,6 +546,7 @@ def merge_slo_snapshots(
     merged: Dict[str, Dict[str, Any]] = {}
     order: List[str] = []
     worst: Optional[Dict[str, Any]] = None
+    classes: Dict[str, Dict[str, Any]] = {}
     scraped = 0
     for idx, body in enumerate(bodies):
         if not body or not body.get("enabled", True):
@@ -448,6 +555,16 @@ def merge_slo_snapshots(
         if not isinstance(objectives, list):
             continue
         scraped += 1
+        for key, cent in (body.get("classes") or {}).items():
+            agg = classes.setdefault(
+                key, {"budget": cent.get("budget"), "windows": {}}
+            )
+            for wname, w in (cent.get("windows") or {}).items():
+                cell = agg["windows"].setdefault(
+                    wname, {"good": 0.0, "total": 0.0}
+                )
+                cell["good"] += float(w.get("good") or 0.0)
+                cell["total"] += float(w.get("total") or 0.0)
         for obj in objectives:
             name = obj.get("name")
             if not name:
@@ -492,8 +609,23 @@ def merge_slo_snapshots(
             w["good"] = round(w["good"], 6)
             w["total"] = round(w["total"], 6)
         objectives_out.append(entry)
-    return {
+    for agg in classes.values():
+        budget = agg.get("budget") or 0.001
+        for w in agg["windows"].values():
+            if w["total"] > 0:
+                ratio = w["good"] / w["total"]
+                w["ratio"] = round(ratio, 6)
+                w["burn_rate"] = round(max(0.0, 1.0 - ratio) / budget, 4)
+            else:
+                w["ratio"] = None
+                w["burn_rate"] = 0.0
+            w["good"] = round(w["good"], 6)
+            w["total"] = round(w["total"], 6)
+    out = {
         "replicas_scraped": scraped,
         "objectives": objectives_out,
         "worst_burn": worst,
     }
+    if classes:
+        out["classes"] = dict(sorted(classes.items()))
+    return out

@@ -1,0 +1,68 @@
+"""Multi-tenant QoS: priority classes, weighted-fair batching, admission.
+
+The serving plane treats every request identically until this package is
+wired in; with it, each request carries a ``(tenant, class)`` identity
+(``interactive`` / ``batch`` / ``best_effort``) parsed from headers on
+the JSON path and from the ``__meta__`` tensor sidecar on the binary
+path, and three mechanisms keep the fleet fair under overload:
+
+- :class:`~gordo_components_tpu.qos.fair.WeightedFairQueue` — per-class
+  virtual-time queues inside the batching engine (WFQ/DRR style) so a
+  batch-class flood cannot starve interactive traffic, with class-aware
+  deadline ordering inside each class.
+- :class:`~gordo_components_tpu.qos.admission.AdmissionController` —
+  per-tenant token buckets plus per-class queue-pressure thresholds, so
+  overload sheds the classes that opted into being sheddable first, and
+  (goodput-driven) the class already burning SLO budget fastest; every
+  refusal carries a computed ``Retry-After``, never a blind reject.
+- per-class goodput/burn accounting in observability/goodput.py and
+  slo.py (``gordo_goodput_tenant_requests_total{tenant,class}``,
+  ``gordo_slo_burn_rate{tenant,class,window}``) feeding the admission
+  loop and the watchman fleet rollup.
+
+Everything defaults open: with no configuration, every request is
+``interactive`` for tenant ``default`` and behavior is byte-identical to
+the pre-QoS plane (one FIFO class, no buckets).
+"""
+
+from gordo_components_tpu.qos.classify import (  # noqa: F401
+    CLASSES,
+    DEFAULT_CLASS,
+    DEFAULT_TENANT,
+    PRIORITY_HEADER,
+    TENANT_HEADER,
+    RequestClass,
+    classify_headers,
+    classify_meta,
+    normalize_class,
+    normalize_tenant,
+)
+from gordo_components_tpu.qos.admission import (  # noqa: F401
+    AdmissionController,
+    QosShed,
+    TokenBucket,
+)
+from gordo_components_tpu.qos.fair import (  # noqa: F401
+    DEFAULT_WEIGHTS,
+    WeightedFairQueue,
+    parse_weights,
+)
+
+__all__ = [
+    "CLASSES",
+    "DEFAULT_CLASS",
+    "DEFAULT_TENANT",
+    "DEFAULT_WEIGHTS",
+    "PRIORITY_HEADER",
+    "TENANT_HEADER",
+    "RequestClass",
+    "AdmissionController",
+    "QosShed",
+    "TokenBucket",
+    "WeightedFairQueue",
+    "classify_headers",
+    "classify_meta",
+    "normalize_class",
+    "normalize_tenant",
+    "parse_weights",
+]

@@ -1,0 +1,358 @@
+"""Goodput-driven admission: per-tenant token buckets, per-class shed.
+
+The controller sits in front of the batching engine and answers one
+question per scoring request — admit, or refuse with an honest
+``Retry-After``. Three ordered rules:
+
+1. **Tenant token bucket** (``GORDO_QOS_TENANTS``): a tenant named in
+   the config draws one token per request from its bucket; an empty
+   bucket refuses with ``Retry-After = deficit / refill_rate`` — the
+   exact wait until a token exists, not a guess. Unknown tenants are
+   default-open (no bucket, counted, label-collapsed to ``other``).
+2. **Per-class queue pressure** (``GORDO_QOS_SHED_FRACTIONS``): each
+   class sheds once the engine backlog crosses its own fraction of
+   ``max_queue`` (defaults: best_effort 0.5, batch 0.75, interactive
+   1.0) — weaker classes give up their queue slots to stronger ones
+   well before the hard full-queue backstop.
+3. **Goodput burn** : under pressure (backlog past the weakest class's
+   threshold), a sheddable class whose fast-window SLO burn rate is the
+   highest of all classes and past ``GORDO_QOS_BURN_SHED`` is refused
+   even below its own depth threshold — when the device is the
+   bottleneck, drop the class already burning budget fastest instead of
+   round-robin (PAPERS.md #5's goodput framing). Classes with shed
+   fraction >= 1.0 (interactive by default) are never burn-shed: their
+   only limit is the full queue.
+
+Every refusal raises :class:`QosShed` carrying ``retry_after_s`` and a
+machine-readable reason; the HTTP layer renders it as a 429 with a
+``Retry-After`` header and a JSON body, and the client's per-class
+retry policy honors it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Optional, Tuple
+
+from gordo_components_tpu.qos.classify import (
+    CLASSES,
+    DEFAULT_TENANT,
+    RequestClass,
+)
+
+logger = logging.getLogger(__name__)
+
+_ENV_TENANTS = "GORDO_QOS_TENANTS"
+_ENV_FRACTIONS = "GORDO_QOS_SHED_FRACTIONS"
+_ENV_BURN_SHED = "GORDO_QOS_BURN_SHED"
+
+#: Backlog fraction of ``max_queue`` past which each class is refused
+#: at admission. 1.0 means "only the engine's own full-queue backstop".
+DEFAULT_SHED_FRACTIONS: Dict[str, float] = {
+    "interactive": 1.0,
+    "batch": 0.75,
+    "best_effort": 0.5,
+}
+
+#: Fast-window burn rate past which the hottest sheddable class is
+#: refused under queue pressure (burn 1.0 = consuming error budget
+#: exactly as fast as the SLO window allows; 2.0 = twice that).
+DEFAULT_BURN_SHED = 2.0
+
+
+class QosShed(Exception):
+    """Admission refused this request. Always retryable, never blind:
+    ``retry_after_s`` says when, ``reason`` says why
+    (``tenant_rate`` | ``queue_pressure`` | ``goodput_burn``)."""
+
+    def __init__(
+        self,
+        reason: str,
+        retry_after_s: float,
+        tenant: str = DEFAULT_TENANT,
+        qos_class: str = "interactive",
+    ):
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        self.tenant = tenant
+        self.qos_class = qos_class
+        super().__init__(
+            f"admission refused ({reason}) for tenant={tenant} "
+            f"class={qos_class}; retry in ~{self.retry_after_s:.2f}s"
+        )
+
+
+class TokenBucket:
+    """Classic token bucket with an injectable monotonic clock (tests
+    and replay drive it deterministically). Thread-safe: the shm
+    transport admits from plain threads."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = float(rate)
+        if self.rate <= 0:
+            raise ValueError(f"rate must be positive, got {rate!r}")
+        self.burst = float(burst) if burst is not None else max(2 * self.rate, 1.0)
+        self._tokens = self.burst
+        self._clock = clock
+        self._stamp = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> Tuple[bool, float]:
+        """Take ``n`` tokens if available. Returns ``(admitted,
+        retry_after_s)`` — on refusal the wait is the exact deficit over
+        the refill rate."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rate
+            )
+            self._stamp = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            return False, (n - self._tokens) / self.rate
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = self._clock()
+            tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rate
+            )
+        return {"rate": self.rate, "burst": self.burst, "tokens": round(tokens, 3)}
+
+
+def _parse_fractions(spec: Optional[str]) -> Dict[str, float]:
+    fractions = dict(DEFAULT_SHED_FRACTIONS)
+    for part in (spec or "").split(","):
+        if "=" not in part:
+            continue
+        name, _, raw = part.partition("=")
+        name = name.strip().lower().replace("-", "_")
+        if name not in fractions:
+            continue
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        if 0 < value <= 1.0:
+            fractions[name] = value
+    return fractions
+
+
+def parse_tenants(spec: Optional[str], clock=time.monotonic) -> Dict[str, TokenBucket]:
+    """``GORDO_QOS_TENANTS`` -> buckets. The value is JSON:
+    ``{"acme": {"rate": 50, "burst": 100}, "backfill": {"rate": 5}}``.
+    A malformed document logs and yields no buckets (default-open) —
+    a config typo must not refuse the whole fleet."""
+    if not spec:
+        return {}
+    try:
+        doc = json.loads(spec)
+        if not isinstance(doc, dict):
+            raise ValueError("tenant config must be a JSON object")
+    except ValueError as exc:
+        logger.warning("ignoring malformed %s: %s", _ENV_TENANTS, exc)
+        return {}
+    buckets: Dict[str, TokenBucket] = {}
+    for tenant, cfg in doc.items():
+        if not isinstance(cfg, dict) or "rate" not in cfg:
+            logger.warning("ignoring tenant %r: no rate", tenant)
+            continue
+        try:
+            buckets[str(tenant)[:64]] = TokenBucket(
+                cfg["rate"], cfg.get("burst"), clock=clock
+            )
+        except (TypeError, ValueError) as exc:
+            logger.warning("ignoring tenant %r: %s", tenant, exc)
+    return buckets
+
+
+class AdmissionController:
+    """Admit-or-refuse for the scoring path; see the module docstring
+    for the three rules. One instance per app, shared by every worker
+    loop and transport thread (all state is lock-protected or
+    read-only after construction)."""
+
+    def __init__(
+        self,
+        tenants: Optional[Dict[str, TokenBucket]] = None,
+        shed_fractions: Optional[Dict[str, float]] = None,
+        burn_shed: float = DEFAULT_BURN_SHED,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.buckets = dict(tenants or {})
+        self.known_tenants = frozenset(self.buckets)
+        fractions = dict(DEFAULT_SHED_FRACTIONS)
+        if shed_fractions:
+            for name, value in shed_fractions.items():
+                if name in fractions and 0 < value <= 1.0:
+                    fractions[name] = float(value)
+        self.shed_fractions = fractions
+        # pressure starts where the WEAKEST class begins shedding: below
+        # that depth the queue is healthy and burn-shedding would refuse
+        # traffic the engine could happily absorb
+        self.pressure_fraction = min(fractions.values())
+        self.burn_shed = float(burn_shed)
+        self._clock = clock
+        # per-class fast-window burn provider, wired after construction
+        # (build_app points it at the SLOTracker): class -> burn | None
+        self.burn_for: Optional[Callable[[str], Optional[float]]] = None
+        self._lock = threading.Lock()
+        # (tenant_label, class) -> count; tenant labels are bounded by
+        # classification (known tenants + default + "other")
+        self.admitted: Dict[Tuple[str, str], int] = {}
+        self.shed: Dict[Tuple[str, str, str], int] = {}  # +reason
+        self.unknown_tenants = 0
+
+    @classmethod
+    def from_env(cls, env=os, clock: Callable[[], float] = time.monotonic):
+        environ = getattr(env, "environ", env)
+        return cls(
+            tenants=parse_tenants(environ.get(_ENV_TENANTS), clock=clock),
+            shed_fractions=_parse_fractions(environ.get(_ENV_FRACTIONS)),
+            burn_shed=_float_env(environ, _ENV_BURN_SHED, DEFAULT_BURN_SHED),
+            clock=clock,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def admit(
+        self,
+        rc: RequestClass,
+        queue_depth: int = 0,
+        max_queue: Optional[int] = None,
+        drain_s: float = 0.05,
+    ) -> str:
+        """Admit ``rc`` or raise :class:`QosShed`. ``queue_depth`` /
+        ``max_queue`` come from the engine at call time; ``drain_s`` is
+        the engine's drain estimate, used as Retry-After for
+        depth/burn sheds. Returns the cardinality-bounded tenant label
+        the caller should stamp on metrics."""
+        label = rc.label_tenant(self.known_tenants)
+        if label == "other":
+            with self._lock:
+                self.unknown_tenants += 1
+        bucket = self.buckets.get(rc.tenant)
+        if bucket is not None:
+            ok, wait_s = bucket.try_take()
+            if not ok:
+                self._count_shed(label, rc.qos_class, "tenant_rate")
+                raise QosShed(
+                    "tenant_rate", wait_s, tenant=label, qos_class=rc.qos_class
+                )
+        if max_queue:
+            fraction = self.shed_fractions.get(rc.qos_class, 1.0)
+            if queue_depth >= math.ceil(fraction * max_queue):
+                self._count_shed(label, rc.qos_class, "queue_pressure")
+                raise QosShed(
+                    "queue_pressure",
+                    max(drain_s, 0.05),
+                    tenant=label,
+                    qos_class=rc.qos_class,
+                )
+            if (
+                fraction < 1.0
+                and self.burn_for is not None
+                and queue_depth >= math.ceil(self.pressure_fraction * max_queue)
+            ):
+                burn = self.burn_for(rc.qos_class)
+                if burn is not None and burn >= self.burn_shed:
+                    others = [
+                        b
+                        for c in CLASSES
+                        if c != rc.qos_class
+                        and (b := self.burn_for(c)) is not None
+                    ]
+                    if not others or burn >= max(others):
+                        self._count_shed(label, rc.qos_class, "goodput_burn")
+                        raise QosShed(
+                            "goodput_burn",
+                            max(drain_s, 0.05),
+                            tenant=label,
+                            qos_class=rc.qos_class,
+                        )
+        with self._lock:
+            key = (label, rc.qos_class)
+            self.admitted[key] = self.admitted.get(key, 0) + 1
+        return label
+
+    def _count_shed(self, tenant: str, qos_class: str, reason: str) -> None:
+        with self._lock:
+            key = (tenant, qos_class, reason)
+            self.shed[key] = self.shed.get(key, 0) + 1
+
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> dict:
+        """Controller state for GET /qos."""
+        with self._lock:
+            admitted = {
+                f"{t}|{c}": n for (t, c), n in sorted(self.admitted.items())
+            }
+            shed = {
+                f"{t}|{c}|{r}": n
+                for (t, c, r), n in sorted(self.shed.items())
+            }
+            unknown = self.unknown_tenants
+        return {
+            "tenants": {t: b.snapshot() for t, b in sorted(self.buckets.items())},
+            "shed_fractions": dict(self.shed_fractions),
+            "burn_shed_threshold": self.burn_shed,
+            "admitted": admitted,
+            "shed": shed,
+            "unknown_tenants": unknown,
+        }
+
+    def install_collector(self, registry) -> None:
+        """Expose admission counters through the registry's
+        read-through collector seam (same no-drift contract as the
+        engine: /metrics and GET /qos read the SAME dicts)."""
+        if registry is None:
+            return
+        ref = weakref.ref(self)
+
+        def collect():
+            ctl = ref()
+            if ctl is None:
+                return
+            with ctl._lock:
+                admitted = dict(ctl.admitted)
+                shed = dict(ctl.shed)
+                unknown = ctl.unknown_tenants
+            for (tenant, cls), n in sorted(admitted.items()):
+                yield (
+                    "gordo_qos_admitted_total", "counter",
+                    "Requests admitted by the QoS controller",
+                    {"tenant": tenant, "class": cls}, n,
+                )
+            for (tenant, cls, reason), n in sorted(shed.items()):
+                yield (
+                    "gordo_qos_shed_total", "counter",
+                    "Requests refused at admission (429 + Retry-After)",
+                    {"tenant": tenant, "class": cls, "reason": reason}, n,
+                )
+            yield (
+                "gordo_qos_unknown_tenant_total", "counter",
+                "Requests whose tenant was collapsed to the 'other' label",
+                {}, unknown,
+            )
+
+        registry.collector(collect, key="qos_admission")
+
+
+def _float_env(environ, key: str, default: float) -> float:
+    try:
+        return float(environ.get(key, default))
+    except (TypeError, ValueError):
+        return default

@@ -1,0 +1,131 @@
+"""Request classification: tenant + priority class.
+
+One identity per request — ``RequestClass(tenant, qos_class)`` — parsed
+once at admission and carried through the queue, the ledger, and the
+metric labels. Two transports feed it:
+
+- HTTP headers ``X-Gordo-Tenant`` / ``X-Gordo-Priority`` (the JSON and
+  parquet paths, and the tensor path's outer envelope);
+- the ``__meta__`` tensor sidecar frame (PR 10) on the binary GTNS
+  path, where ``{"tenant": ..., "priority": ...}`` keys override the
+  headers — shm envelopes have no headers, so the sidecar IS the
+  contract there.
+
+Tenant labels are bounded at classification time: only tenants named in
+the QoS config keep their own label; everything else collapses to
+``other`` BEFORE it can reach a metric family, so an unknown-tenant
+flood can never explode series cardinality (the PR 18 guard stays a
+backstop, not the first line of defense). Admission itself stays
+default-open for unknown tenants — collapsing the *label* is not a
+refusal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional
+
+#: Priority classes, strongest to weakest. ``interactive`` is the
+#: default: untagged traffic must keep pre-QoS behavior (never sheddable
+#: below the full-queue backstop, full retry/hedge policy).
+CLASSES = ("interactive", "batch", "best_effort")
+
+DEFAULT_CLASS = "interactive"
+DEFAULT_TENANT = "default"
+
+#: Collapsed label for tenants not named in the QoS config — bounded
+#: metric cardinality no matter how many distinct tenant strings arrive.
+OTHER_TENANT = "other"
+
+TENANT_HEADER = "X-Gordo-Tenant"
+PRIORITY_HEADER = "X-Gordo-Priority"
+
+# accepted spellings -> canonical class (clients say "best-effort",
+# batch pipelines say "bulk"; one canonical label keeps metrics joinable)
+_CLASS_ALIASES = {
+    "interactive": "interactive",
+    "online": "interactive",
+    "batch": "batch",
+    "bulk": "batch",
+    "best_effort": "best_effort",
+    "best-effort": "best_effort",
+    "besteffort": "best_effort",
+}
+
+
+def normalize_class(value: Any, default: str = DEFAULT_CLASS) -> str:
+    """Canonical priority class for ``value`` (header or meta field).
+
+    Unknown/empty values fall back to ``default`` — a typo in a priority
+    header must degrade to ordinary service, not an error."""
+    if not isinstance(value, str):
+        return default
+    return _CLASS_ALIASES.get(value.strip().lower(), default)
+
+
+def normalize_tenant(value: Any) -> str:
+    """Sanitized tenant string (NOT yet cardinality-bounded — that needs
+    the known-tenant set, see :meth:`RequestClass.label_tenant`)."""
+    if not isinstance(value, str):
+        return DEFAULT_TENANT
+    # "|" is the tenant|class join character in snapshots and sample
+    # keys (slo.py) — it can't be allowed inside a tenant string
+    tenant = value.strip().replace("|", "_")[:64]
+    return tenant if tenant else DEFAULT_TENANT
+
+
+@dataclass(frozen=True)
+class RequestClass:
+    """The per-request QoS identity: who sent it, how urgent it is."""
+
+    tenant: str = DEFAULT_TENANT
+    qos_class: str = DEFAULT_CLASS
+
+    def label_tenant(self, known_tenants) -> str:
+        """The tenant string safe to use as a metric label: itself when
+        named in the config (or the default), ``other`` otherwise."""
+        if self.tenant == DEFAULT_TENANT or (
+            known_tenants and self.tenant in known_tenants
+        ):
+            return self.tenant
+        return OTHER_TENANT
+
+
+#: Shared default identity: untagged traffic (the overwhelmingly common
+#: case) must not allocate a dataclass per request on the hot loop.
+DEFAULT_REQUEST_CLASS = RequestClass()
+
+
+def classify_headers(headers: Mapping[str, str]) -> RequestClass:
+    """Parse the QoS identity from HTTP headers (missing -> defaults)."""
+    tenant = headers.get(TENANT_HEADER)
+    priority = headers.get(PRIORITY_HEADER)
+    if not tenant and not priority:
+        return DEFAULT_REQUEST_CLASS
+    return RequestClass(
+        tenant=normalize_tenant(tenant),
+        qos_class=normalize_class(priority),
+    )
+
+
+def classify_meta(
+    meta: Optional[Mapping[str, Any]], base: Optional[RequestClass] = None
+) -> RequestClass:
+    """Overlay ``__meta__`` sidecar keys on a header-derived identity.
+
+    The sidecar wins where present: the binary path's framed body may
+    cross proxies that strip custom headers, and the shm envelope never
+    had headers at all."""
+    if base is None:
+        base = RequestClass()
+    if not meta:
+        return base
+    tenant = base.tenant
+    qos_class = base.qos_class
+    if "tenant" in meta:
+        tenant = normalize_tenant(meta.get("tenant"))
+    if "priority" in meta:
+        qos_class = normalize_class(meta.get("priority"), default=qos_class)
+    if tenant == base.tenant and qos_class == base.qos_class:
+        return base
+    return RequestClass(tenant=tenant, qos_class=qos_class)

@@ -171,6 +171,14 @@ async def _stats_middleware(request, handler):
     request["deadline"] = (
         Deadline.after_ms(deadline_ms) if deadline_ms else None
     )
+    # QoS identity (qos/classify.py): headers here, possibly overridden
+    # by the binary body's __meta__ sidecar in _parse_scoring — the
+    # FINAL value on the request is what the ledger attributes below.
+    # Untagged traffic gets the shared default instance (no allocation).
+    if kind in ("prediction", "anomaly"):
+        from gordo_components_tpu.qos.classify import classify_headers
+
+        request["qos"] = classify_headers(request.headers)
     tracer = request.app.get("tracer")
     trace = None
     if tracer is not None:
@@ -230,6 +238,17 @@ async def _stats_middleware(request, handler):
         ):
             ledger = request.app.get("goodput")
             if ledger is not None:
+                # per-class attribution: the tenant label is the
+                # cardinality-BOUNDED one (known tenants + default +
+                # "other") — stamped by admission when it ran, derived
+                # here otherwise, never the raw header string
+                qos = request.get("qos")
+                tenant_label = request.get("qos_label")
+                if qos is not None and tenant_label is None:
+                    adm = request.app.get("qos_admission")
+                    tenant_label = qos.label_tenant(
+                        adm.known_tenants if adm is not None else None
+                    )
                 # under the pool, finish_request callers multiply (one
                 # per worker loop) — the ledger's single-writer cell
                 # contract is restored by the same stats lock
@@ -239,6 +258,10 @@ async def _stats_middleware(request, handler):
                         elapsed_s=elapsed,
                         device_s=request.get("device_s", 0.0),
                         scores_finite=request.get("scores_finite", True),
+                        tenant=tenant_label or "default",
+                        qos_class=(
+                            qos.qos_class if qos is not None else "interactive"
+                        ),
                     )
         if trace is not None:
             trace.finish(error=status >= 400, status=status)
@@ -552,6 +575,20 @@ def build_app(
         app["slo"] = SLOTracker(
             ledger, registry=registry, clock=app["clock"].monotonic
         )
+    # multi-tenant QoS admission (qos/admission.py): per-tenant token
+    # buckets + per-class shed thresholds in front of the engine, wired
+    # to the SLO tracker's per-class fast-window burn so overload sheds
+    # the class already burning budget fastest. Always constructed —
+    # with no GORDO_QOS_TENANTS it is default-open and the scoring path
+    # pays one depth comparison per request.
+    from gordo_components_tpu.qos.admission import AdmissionController
+
+    admission = AdmissionController.from_env()
+    app["qos_admission"] = admission
+    admission.install_collector(registry)
+    slo_tracker = app.get("slo")
+    if slo_tracker is not None and hasattr(slo_tracker, "class_burn"):
+        admission.burn_for = slo_tracker.class_burn
     # access-heat accountant + device-cost attribution (observability/
     # heat.py, cost.py): heat is APP-level state — every bank generation
     # feeds the same accountant, so the decayed per-member history

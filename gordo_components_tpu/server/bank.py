@@ -1800,6 +1800,12 @@ class _Pending:
     # saturated replicas must spend TPU time only on answers someone is
     # still waiting for; None = no budget, never expires
     deadline: Optional[Deadline] = None
+    # QoS identity stamped at admission (qos/classify.py): the fair
+    # queue dequeues by qos_class, and sheds/deadline-expiries attribute
+    # to the right (tenant, class) in /stats and the per-class ledger
+    # cells even when the drop happens long after the HTTP layer let go
+    tenant: str = "default"
+    qos_class: str = "interactive"
 
 
 class EngineOverloaded(Exception):
@@ -1841,6 +1847,7 @@ class BatchingEngine:
         max_queue: Optional[int] = None,
         registry=None,
         dispatch_lock=None,
+        class_weights=None,
     ):
         self.bank = bank
         # multi-worker serving (server/workers.py): each worker loop
@@ -1860,7 +1867,16 @@ class BatchingEngine:
         if int(max_queue) <= 0:
             raise ValueError(f"max_queue must be positive, got {max_queue!r}")
         self.max_queue = int(max_queue)
-        self._queue: "asyncio.Queue[_Pending]" = asyncio.Queue()
+        # weighted-fair queue (qos/fair.py): duck-compatible with the
+        # asyncio.Queue it replaced — per-class virtual-time dequeue so
+        # a batch-class flood cannot starve interactive traffic, and
+        # deadline-ordered pops inside each class. With every request in
+        # the default class (no QoS config) this degenerates to FIFO.
+        from gordo_components_tpu.qos.fair import WeightedFairQueue, parse_weights
+
+        if class_weights is None:
+            class_weights = parse_weights()
+        self._queue: "WeightedFairQueue" = WeightedFairQueue(class_weights)
         self._task: Optional[asyncio.Task] = None
         # the loop that owns the queue + consumer task, captured at
         # start(): every engine-internal future/queue op must happen on
@@ -1882,6 +1898,15 @@ class BatchingEngine:
             "max_batch_seen": 0,
             "shed": 0,
             "deadline_expired": 0,
+        }
+        # per-class attribution of the same events (ISSUE 19 satellite:
+        # sheds and deadline-expiry drops must name the class/tenant that
+        # ate them, retroactively visible in /stats and /metrics)
+        from gordo_components_tpu.qos.classify import CLASSES
+
+        self.class_stats = {
+            c: {"requests": 0, "shed": 0, "deadline_expired": 0}
+            for c in CLASSES
         }
         # the flush_ms coalescing window trades latency for throughput;
         # these histograms quantify that trade (VERDICT r3 next #4):
@@ -1985,6 +2010,57 @@ class BatchingEngine:
             "gordo_engine_max_queue", "gauge",
             "Queue bound before requests shed", {}, self.max_queue,
         )
+        # per-class attribution (ISSUE 19): separate families rather than
+        # extra labels on the aggregates above, so existing dashboards'
+        # unlabeled series stay byte-identical
+        depths = self._queue.depths() if hasattr(self._queue, "depths") else {}
+        for cls, cs in self.class_stats.items():
+            yield (
+                "gordo_engine_class_requests_total", "counter",
+                "Requests dispatched by the engine, by priority class",
+                {"class": cls}, cs["requests"],
+            )
+            yield (
+                "gordo_engine_class_shed_total", "counter",
+                "Full-queue sheds by priority class",
+                {"class": cls}, cs["shed"],
+            )
+            yield (
+                "gordo_engine_class_deadline_expired_total", "counter",
+                "Deadline-expiry drops by priority class",
+                {"class": cls}, cs["deadline_expired"],
+            )
+            yield (
+                "gordo_engine_class_queue_depth", "gauge",
+                "Live scoring-queue depth by priority class",
+                {"class": cls}, depths.get(cls, 0),
+            )
+
+    def qos_snapshot(self) -> dict:
+        """Engine-side half of GET /qos: fair-queue state + per-class
+        counters (read-through, same dicts the metrics render), plus
+        each banked target's feature width — the promotion gate's flood
+        driver needs a VALID body shape (a wrong-width flood would end
+        as model errors and could trip the quarantine breaker on the
+        very canary being gated)."""
+        queue = (
+            self._queue.snapshot() if hasattr(self._queue, "snapshot") else {}
+        )
+        widths: Dict[str, int] = {}
+        bank = self.bank
+        index = getattr(bank, "_index", None)
+        buckets = getattr(bank, "_buckets", None)
+        if index and buckets:
+            for name, (bucket_key, _i) in index.items():
+                bucket = buckets.get(bucket_key)
+                if bucket is not None:
+                    widths[name] = int(bucket.n_features)
+        return {
+            "queue": queue,
+            "max_queue": self.max_queue,
+            "classes": {c: dict(cs) for c, cs in self.class_stats.items()},
+            "feature_widths": widths,
+        }
 
     def start(self) -> None:
         if self._task is None:
@@ -2009,6 +2085,8 @@ class BatchingEngine:
         request_id: Optional[str] = None,
         trace=None,
         deadline: Optional[Deadline] = None,
+        tenant: str = "default",
+        qos_class: str = "interactive",
     ) -> ScoreResult:
         """:meth:`score` from WHICHEVER event loop is running.
 
@@ -2031,49 +2109,68 @@ class BatchingEngine:
         if loop is None or asyncio.get_running_loop() is loop:
             return await self.score(
                 name, X, y, request_id=request_id, trace=trace,
-                deadline=deadline,
+                deadline=deadline, tenant=tenant, qos_class=qos_class,
             )
         _FP_ENGINE_QUEUE.fire()
         if deadline is not None and deadline.expired():
-            self._bump_threadsafe("deadline_expired")
+            self._bump_threadsafe("deadline_expired", qos_class)
             raise DeadlineExceeded(
                 f"deadline expired before admission (rid={request_id}, "
                 f"budget {deadline.budget_s * 1e3:.0f}ms)"
             )
         depth = self._queue.qsize()  # racy read: shed is a heuristic gate
         if depth >= self.max_queue:
-            self._bump_threadsafe("shed")
-            if self.service.count:
-                batch_s = max(
-                    self.service.percentile(0.5)
-                    - self.queue_wait.percentile(0.5),
-                    1e-3,
-                )
-            else:
-                batch_s = 0.05
-            raise EngineOverloaded(
-                depth, max(self.flush_s, depth / self.max_batch * batch_s)
-            )
+            self._bump_threadsafe("shed", qos_class)
+            raise EngineOverloaded(depth, self.drain_estimate(depth))
         fut: Any = ConcurrentFuture()  # thread-safe resolve from the engine loop
         pending = _Pending(
-            name, X, y, fut, time.monotonic(), request_id, trace, deadline
+            name, X, y, fut, time.monotonic(), request_id, trace, deadline,
+            tenant, qos_class,
         )
         loop.call_soon_threadsafe(self._queue.put_nowait, pending)
         # wrap_future bridges resolution (and caller-side cancellation)
         # back onto this worker's loop
         return await asyncio.wrap_future(fut)
 
-    def _bump_threadsafe(self, key: str) -> None:
+    def _bump_threadsafe(self, key: str, qos_class: Optional[str] = None) -> None:
         """Counter increment from a foreign loop/thread, serialized onto
         the engine's loop so stats never lose increments."""
         loop = self._loop
+
+        def bump():
+            self.stats[key] = self.stats[key] + 1
+            self._bump_class(qos_class, key)
+
         try:
             if loop is not None:
-                loop.call_soon_threadsafe(
-                    lambda: self.stats.__setitem__(key, self.stats[key] + 1)
-                )
+                loop.call_soon_threadsafe(bump)
         except RuntimeError:
             pass  # engine loop already closed (shutdown race): drop the count
+
+    def _bump_class(self, qos_class: Optional[str], key: str) -> None:
+        """Per-class twin of a ``stats`` bump (engine loop / same-loop
+        callers only — cross-loop paths go through _bump_threadsafe)."""
+        cs = self.class_stats.get(qos_class)
+        if cs is not None and key in cs:
+            cs[key] += 1
+
+    def drain_estimate(self, depth: Optional[int] = None) -> float:
+        """Honest Retry-After for a shed: backlog batches x per-batch
+        EXECUTION time. Service p50 includes queue wait, which under
+        saturation IS the backlog — subtract it or the estimate
+        double-counts the queue and clients back off max_queue/max_batch
+        times longer than the true drain. One estimator for every shed
+        path (HTTP, cross-loop, shm) and for the admission controller."""
+        if depth is None:
+            depth = self._queue.qsize()
+        if self.service.count:
+            batch_s = max(
+                self.service.percentile(0.5) - self.queue_wait.percentile(0.5),
+                1e-3,
+            )
+        else:
+            batch_s = 0.05
+        return max(self.flush_s, depth / self.max_batch * batch_s)
 
     def score_blocking(
         self,
@@ -2082,6 +2179,8 @@ class BatchingEngine:
         y: Optional[np.ndarray] = None,
         request_id: Optional[str] = None,
         timeout: Optional[float] = None,
+        tenant: str = "default",
+        qos_class: str = "interactive",
     ) -> ScoreResult:
         """:meth:`score` from a plain thread (the shared-memory transport
         server, utils/shm_ring.py): blocks the calling thread — never an
@@ -2097,11 +2196,12 @@ class BatchingEngine:
         _FP_ENGINE_QUEUE.fire()
         depth = self._queue.qsize()
         if depth >= self.max_queue:
-            self._bump_threadsafe("shed")
-            raise EngineOverloaded(depth, self.flush_s)
+            self._bump_threadsafe("shed", qos_class)
+            raise EngineOverloaded(depth, self.drain_estimate(depth))
         fut: Any = ConcurrentFuture()
         pending = _Pending(
-            name, X, y, fut, time.monotonic(), request_id, None, None
+            name, X, y, fut, time.monotonic(), request_id, None, None,
+            tenant, qos_class,
         )
         loop.call_soon_threadsafe(self._queue.put_nowait, pending)
         try:
@@ -2118,6 +2218,8 @@ class BatchingEngine:
         request_id: Optional[str] = None,
         trace=None,
         deadline: Optional[Deadline] = None,
+        tenant: str = "default",
+        qos_class: str = "interactive",
     ) -> ScoreResult:
         _FP_ENGINE_QUEUE.fire()
         self.start()
@@ -2127,6 +2229,7 @@ class BatchingEngine:
             # refusing here costs nothing — queueing it would only grow
             # the backlog by work already known to be waste
             self.stats["deadline_expired"] += 1
+            self._bump_class(qos_class, "deadline_expired")
             if trace is not None:
                 now = time.monotonic()
                 trace.add_span(
@@ -2142,24 +2245,14 @@ class BatchingEngine:
             # this deep, a new waiter's latency is already >= the whole
             # backlog's service time, so the honest answer is "retry"
             self.stats["shed"] += 1
-            # drain estimate: backlog batches x per-batch EXECUTION time.
-            # service p50 includes queue wait, which under saturation IS
-            # the backlog — subtract it or the estimate double-counts the
-            # queue and clients back off max_queue/max_batch times longer
-            # than the true drain
-            if self.service.count:
-                batch_s = max(
-                    self.service.percentile(0.5) - self.queue_wait.percentile(0.5),
-                    1e-3,
-                )
-            else:
-                batch_s = 0.05
-            raise EngineOverloaded(
-                depth, max(self.flush_s, depth / self.max_batch * batch_s)
-            )
+            self._bump_class(qos_class, "shed")
+            raise EngineOverloaded(depth, self.drain_estimate(depth))
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put(
-            _Pending(name, X, y, fut, time.monotonic(), request_id, trace, deadline)
+            _Pending(
+                name, X, y, fut, time.monotonic(), request_id, trace,
+                deadline, tenant, qos_class,
+            )
         )
         return await fut
 
@@ -2214,6 +2307,8 @@ class BatchingEngine:
                 except asyncio.TimeoutError:
                     break
             self.stats["requests"] += len(batch)
+            for p in batch:
+                self._bump_class(p.qos_class, "requests")
             self.stats["batches"] += 1
             self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], len(batch))
             dispatch = time.monotonic()
@@ -2228,6 +2323,7 @@ class BatchingEngine:
             for p in batch:
                 if p.deadline is not None and p.deadline.expired(dispatch):
                     self.stats["deadline_expired"] += 1
+                    self._bump_class(p.qos_class, "deadline_expired")
                     self.queue_wait.record(dispatch - p.enqueued)
                     if led is not None:
                         led.record_queue_wait(dispatch - p.enqueued)
@@ -2376,6 +2472,7 @@ class BatchingEngine:
         future."""
         if p.deadline is not None and p.deadline.expired():
             self.stats["deadline_expired"] += 1
+            self._bump_class(p.qos_class, "deadline_expired")
             if p.trace is not None:
                 now = time.monotonic()
                 p.trace.add_span(

@@ -48,6 +48,20 @@ def decode_tensor_request(
     :class:`~gordo_components_tpu.utils.wire.WireFormatError` (-> 400
     with the reason) on malformed bodies.
     """
+    X, y, _ = decode_tensor_request_ex(raw)
+    return X, y
+
+
+def decode_tensor_request_ex(
+    raw: bytes,
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[Dict[str, Any]]]:
+    """:func:`decode_tensor_request` plus the request's ``__meta__``
+    sidecar (or None): the binary path's carrier for non-tensor request
+    facts — today the QoS identity (``{"tenant", "priority"}``,
+    qos/classify.py), which must survive transports that have no
+    headers (the shm envelope) or proxies that strip custom ones. A
+    malformed sidecar is ignored, not a 400: QoS tagging is best-effort
+    metadata, never a reason to refuse a well-formed tensor body."""
     frames = unpack_frames(raw)
     if "X" not in frames:
         raise WireFormatError(
@@ -59,7 +73,15 @@ def decode_tensor_request(
         raise WireFormatError(
             f"y has {len(y)} rows but X has {len(X)}"
         )
-    return X, y
+    meta: Optional[Dict[str, Any]] = None
+    if "__meta__" in frames:
+        try:
+            doc = json.loads(np.asarray(frames["__meta__"], np.uint8).tobytes())
+            if isinstance(doc, dict):
+                meta = doc
+        except (ValueError, TypeError):
+            pass
+    return X, y, meta
 
 
 def _meta_frame(meta: Dict[str, Any]) -> Tuple[str, np.ndarray]:

@@ -16,9 +16,11 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from gordo_components_tpu.qos.admission import QosShed
+from gordo_components_tpu.qos.classify import classify_meta
 from gordo_components_tpu.server.model_io import (
     anomaly_frame_arrays,
-    decode_tensor_request,
+    decode_tensor_request_ex,
     encode_anomaly_response,
     encode_prediction_response,
 )
@@ -96,15 +98,48 @@ def score_tensor_blocking(
     if endpoint == "anomaly" and not hasattr(model, "anomaly"):
         return _err(422, {"error": "Model does not support anomaly scoring"})
     try:
-        Xf, yf = decode_tensor_request(raw)
+        Xf, yf, meta = decode_tensor_request_ex(raw)
     except WireFormatError as exc:
         return _err(400, {"error": f"tensor body: {exc}"})
     engine = app.get("bank_engine")
     banked = engine is not None and target in getattr(engine, "bank", ())
+    # QoS on the header-less transports: the __meta__ sidecar is the
+    # ONLY identity carrier here, and admission runs the same controller
+    # as the HTTP path — the shm ring must not be a fairness bypass
+    qos = classify_meta(meta)
+    tenant_label = "default"
+    admission = app.get("qos_admission")
+    if admission is not None:
+        depth = engine._queue.qsize() if banked else 0
+        try:
+            tenant_label = admission.admit(
+                qos,
+                queue_depth=depth,
+                max_queue=getattr(engine, "max_queue", 0) if banked else 0,
+                drain_s=(
+                    engine.drain_estimate(depth)
+                    if banked and hasattr(engine, "drain_estimate")
+                    else 0.05
+                ),
+            )
+        except QosShed as exc:
+            return _err(
+                429,
+                {
+                    "error": str(exc),
+                    "reason": exc.reason,
+                    "tenant": exc.tenant,
+                    "class": exc.qos_class,
+                    "retry_after_s": round(exc.retry_after_s, 2),
+                },
+            )
     try:
         if endpoint == "anomaly":
             if banked:
-                result = engine.score_blocking(target, Xf, yf)
+                result = engine.score_blocking(
+                    target, Xf, yf,
+                    tenant=tenant_label, qos_class=qos.qos_class,
+                )
                 body = encode_anomaly_response(
                     result.tags, result.to_arrays(), result.offset
                 )
@@ -124,7 +159,9 @@ def score_tensor_blocking(
             _note_result(app, target, Xf, total_scaled)
             return 200, body
         if banked:
-            result = engine.score_blocking(target, Xf)
+            result = engine.score_blocking(
+                target, Xf, tenant=tenant_label, qos_class=qos.qos_class
+            )
             output = result.model_output
         else:
             output = model.predict(Xf)
@@ -132,7 +169,12 @@ def score_tensor_blocking(
         return 200, encode_prediction_response(output, len(Xf))
     except EngineOverloaded as exc:
         return _err(
-            429, {"error": str(exc), "retry_after_s": round(exc.retry_after_s, 2)}
+            429,
+            {
+                "error": str(exc),
+                "reason": "engine_overloaded",
+                "retry_after_s": round(exc.retry_after_s, 2),
+            },
         )
     except DeadlineExceeded as exc:
         return _err(504, {"error": str(exc)})

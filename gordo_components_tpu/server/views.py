@@ -29,11 +29,13 @@ from aiohttp import web
 
 from gordo_components_tpu import __version__, serializer
 from gordo_components_tpu.observability.tracing import chrome_trace
+from gordo_components_tpu.qos.admission import QosShed
+from gordo_components_tpu.qos.classify import classify_meta
 from gordo_components_tpu.resilience.deadline import DeadlineExceeded
 from gordo_components_tpu.server.bank import EngineOverloaded
 from gordo_components_tpu.server.model_io import (
     anomaly_frame_arrays,
-    decode_tensor_request,
+    decode_tensor_request_ex,
     encode_anomaly_response,
     encode_prediction_response,
 )
@@ -224,11 +226,68 @@ def _http_overloaded(exc: EngineOverloaded) -> web.HTTPTooManyRequests:
     """429 with a drain-estimate Retry-After for a shed request."""
     return web.HTTPTooManyRequests(
         text=json.dumps(
-            {"error": str(exc), "retry_after_s": round(exc.retry_after_s, 2)}
+            {
+                "error": str(exc),
+                "reason": "engine_overloaded",
+                "retry_after_s": round(exc.retry_after_s, 2),
+            }
         ),
         content_type="application/json",
         headers={"Retry-After": str(max(1, math.ceil(exc.retry_after_s)))},
     )
+
+
+def _http_qos_shed(exc: QosShed) -> web.HTTPTooManyRequests:
+    """429 for an admission refusal (qos/admission.py): same honest
+    Retry-After contract as the engine shed, plus the machine-readable
+    reason/tenant/class so a client (or operator) can see WHICH rule
+    refused it — a rate-limited tenant backs off differently than a
+    class under queue pressure."""
+    return web.HTTPTooManyRequests(
+        text=json.dumps(
+            {
+                "error": str(exc),
+                "reason": exc.reason,
+                "tenant": exc.tenant,
+                "class": exc.qos_class,
+                "retry_after_s": round(exc.retry_after_s, 2),
+            }
+        ),
+        content_type="application/json",
+        headers={"Retry-After": str(max(1, math.ceil(exc.retry_after_s)))},
+    )
+
+
+def _qos_admit(request: web.Request, engine) -> tuple:
+    """Run QoS admission for a scoring request; returns the
+    ``(tenant_label, qos_class)`` to stamp on the engine call. Raises
+    the 429 itself on refusal. No controller / no QoS identity -> the
+    defaults, zero extra work."""
+    qos = request.get("qos")
+    admission = request.app.get("qos_admission")
+    if admission is None:
+        return ("default", qos.qos_class if qos is not None else "interactive")
+    if qos is None:
+        from gordo_components_tpu.qos.classify import DEFAULT_REQUEST_CLASS
+
+        qos = DEFAULT_REQUEST_CLASS
+    depth = max_queue = 0
+    drain_s = 0.05
+    if engine is not None:
+        max_queue = getattr(engine, "max_queue", 0)
+        queue = getattr(engine, "_queue", None)
+        depth = queue.qsize() if queue is not None else 0
+        est = getattr(engine, "drain_estimate", None)
+        if est is not None:
+            drain_s = est(depth)
+    try:
+        label = admission.admit(
+            qos, queue_depth=depth, max_queue=max_queue, drain_s=drain_s
+        )
+    except QosShed as exc:
+        raise _http_qos_shed(exc)
+    request["qos_label"] = label
+    return (label, qos.qos_class)
 
 
 def _note_deadline_expired_per_model(request: web.Request) -> None:
@@ -524,6 +583,26 @@ async def slo_view(request: web.Request) -> web.Response:
     return web.json_response(body)
 
 
+@routes.get("/gordo/v0/{project}/qos")
+async def qos_view(request: web.Request) -> web.Response:
+    """Multi-tenant QoS state (qos/): the admission controller's tenant
+    buckets / per-class shed thresholds / admitted+shed counters, and
+    the engine's weighted-fair queue (class weights, per-class depth,
+    virtual clocks, dequeue counts) plus per-class engine counters —
+    the page an operator reads during overload triage to answer "which
+    class is shedding, and why" (docs/operations.md runbook). Counters
+    are the SAME dicts the registry renders (no-drift)."""
+    admission = request.app.get("qos_admission")
+    body: Dict[str, Any] = {
+        "enabled": admission is not None,
+        "admission": admission.snapshot() if admission is not None else {},
+    }
+    engine = request.app.get("bank_engine")
+    if engine is not None and hasattr(engine, "qos_snapshot"):
+        body["engine"] = engine.qos_snapshot()
+    return web.json_response(body)
+
+
 @routes.get("/gordo/v0/{project}/heat")
 async def heat_view(request: web.Request) -> web.Response:
     """Per-member access heat (observability/heat.py): the decayed
@@ -689,6 +768,12 @@ async def server_stats(request: web.Request) -> web.Response:
         # "shed" counter rides in from engine.stats)
         es["max_queue"] = engine.max_queue
         es["queue_depth"] = engine._queue.qsize()
+        # per-class attribution (ISSUE 19): requests/sheds/expiries by
+        # priority class, the same dicts /metrics renders
+        if getattr(engine, "class_stats", None):
+            es["by_class"] = {
+                c: dict(cs) for c, cs in engine.class_stats.items()
+            }
         body["bank_engine"] = es
     worker_engines = request.app.get("worker_engines")
     if worker_engines:
@@ -1439,14 +1524,23 @@ async def results_stream(request: web.Request) -> web.Response:
         )
     timeout = min(max(timeout, 0.0), 60.0)
     if not broker.subscribe(subscriber, target):
+        # consistent shed contract (ISSUE 19 satellite): every 429 in
+        # the serving plane carries Retry-After + a machine-readable
+        # retry_after_s. A full subscriber table drains on the poll
+        # timeout cadence — a vacated slot appears within one long-poll
+        # window, so that IS the honest retry hint.
+        retry_s = max(timeout, 1.0)
         raise web.HTTPTooManyRequests(
             text=json.dumps(
                 {
                     "error": "push subscriber table full "
                     "(GORDO_PUSH_SUBSCRIBERS_MAX)",
+                    "reason": "push_subscribers_full",
+                    "retry_after_s": round(retry_s, 2),
                 }
             ),
             content_type="application/json",
+            headers={"Retry-After": str(max(1, math.ceil(retry_s)))},
         )
     # the wait parks on the push plane's DEDICATED poll pool (sized to
     # the subscriber bound), never the event loop and never the default
@@ -1586,12 +1680,17 @@ async def _parse_scoring(request: web.Request):
         try:
             # bytes -> frombuffer views -> float32 rows; no DataFrame,
             # no per-value boxing (server/model_io.py, utils/wire.py)
-            Xf, yf = decode_tensor_request(raw)
+            Xf, yf, meta = decode_tensor_request_ex(raw)
         except WireFormatError as exc:
             raise web.HTTPBadRequest(
                 text=json.dumps({"error": f"tensor body: {exc}"}),
                 content_type="application/json",
             )
+        if meta:
+            # binary-path QoS identity: the __meta__ sidecar overrides
+            # the headers (qos/classify.py) — the FINAL value here is
+            # what admission gates on and the ledger attributes
+            request["qos"] = classify_meta(meta, request.get("qos"))
     else:
         try:
             X, y = await _parse_request(request)
@@ -1617,6 +1716,7 @@ async def prediction(request: web.Request) -> web.Response:
     target = request.match_info["target"]
     encoding, X, _y, Xf, _yf = await _parse_scoring(request)
     engine = _bank_engine(request)
+    tenant_label, qos_class = _qos_admit(request, engine)
     trace = request.get("trace")
     deadline = request.get("deadline")
     try:
@@ -1627,6 +1727,8 @@ async def prediction(request: web.Request) -> web.Response:
                 request_id=request.get("request_id"),
                 trace=trace,
                 deadline=deadline,
+                tenant=tenant_label,
+                qos_class=qos_class,
             )
             output = result.model_output
             # goodput: the request's share of its group's device window
@@ -1692,6 +1794,7 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
     target = request.match_info["target"]
     encoding, X, y, Xf, yf = await _parse_scoring(request)
     engine = _bank_engine(request)
+    tenant_label, qos_class = _qos_admit(request, engine)
     trace = request.get("trace")
     deadline = request.get("deadline")
     frame = None
@@ -1704,6 +1807,8 @@ async def anomaly_prediction(request: web.Request) -> web.Response:
                 request_id=request.get("request_id"),
                 trace=trace,
                 deadline=deadline,
+                tenant=tenant_label,
+                qos_class=qos_class,
             )
             request["device_s"] = result.device_s
             t0 = time.monotonic()

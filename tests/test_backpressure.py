@@ -152,11 +152,19 @@ async def test_http_429_with_retry_after(tmp_path, one_model):
         for resp, body in shed:
             assert int(resp.headers["Retry-After"]) >= 1
             assert body["retry_after_s"] > 0
-            assert "queue full" in body["error"]
-        # sheds surface in /stats for operators
+            # two honest refusal points share the 429 contract: QoS
+            # admission refuses at the per-class threshold (for the
+            # default interactive class that IS the full queue) before
+            # the engine's own class-blind backstop can fire
+            assert body["reason"] in ("queue_pressure", "engine_overloaded")
+        # sheds surface for operators: admission refusals on /qos,
+        # engine backstop sheds on /stats — together they account for
+        # every 429 the clients saw
         stats = await (await client.get("/gordo/v0/p/stats")).json()
         es = stats["bank_engine"]
-        assert es["shed"] == len(shed)
+        qos = await (await client.get("/gordo/v0/p/qos")).json()
+        admission_sheds = sum(qos["admission"]["shed"].values())
+        assert es["shed"] + admission_sheds == len(shed)
         assert es["max_queue"] == 3
     finally:
         await client.close()
