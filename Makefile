@@ -6,7 +6,7 @@ PYTHON ?= python
 IMAGE_PREFIX ?= gordo-components-tpu
 TAG ?= latest
 
-.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history gameday heat qos hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo gameday-demo capacity-demo qos-demo bench images builder-image server-image watchman-image clean
+.PHONY: test test-fast chaos chaos-deadline slo rebalance stream wire replay saturate mesh fleet history gameday heat qos seqperf hotloop perf-guard trace-demo slo-demo rebalance-demo stream-demo wire-demo replay-demo saturate-demo mesh-demo fleet-demo incident-demo gameday-demo capacity-demo qos-demo bench images builder-image server-image watchman-image clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -162,6 +162,15 @@ qos:
 # not buried in the full run
 hotloop:
 	$(PYTHON) -m pytest tests/ -q -m hotloop --continue-on-collection-errors
+
+# sequence fast-path lane: time-major vs legacy parity (gang epoch,
+# end-to-end fleet incl. the heterogeneous 8-shard leg, bank scoring),
+# interpret-mode fused recurrent-step kernel bands, width-autotune
+# persistence round-trip, width-cap dispatch splitting, gang-scheduled
+# build vs serial, and the time-major>=legacy perf guard
+# (tests/test_seq_fastpath.py)
+seqperf:
+	$(PYTHON) -m pytest tests/ -q -m seqperf --continue-on-collection-errors
 
 # perf-guard lane: every hot-loop overhead guard PLUS the pipelined-vs-
 # serial parity+no-slower check (tests/test_bank_pipeline.py) PLUS the

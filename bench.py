@@ -1752,17 +1752,68 @@ def _bench_family_fleet(
         FleetTrainer(host_sync_every=1, **single_cfg).fit({name: members[name]})
     single_rate = n_probe / (time.time() - t0) * 3600 / n_chips
 
+    buckets = trainer.last_stats.get("buckets", [])
     out = {
         f"{fam}_fleet_models_per_hour_per_chip": round(fleet_rate, 1),
         f"{fam}_fleet_wall_seconds": round(elapsed, 2),
         f"{fam}_fleet_vs_single_same_arch": round(fleet_rate / single_rate, 1),
+        # sequence fast-path provenance (ops/seq_scan.py): which layout
+        # the measured epoch programs compiled with, and the width cap
+        # the dispatches ran under (None = uncapped; GORDO_FLEET_WIDTH
+        # =auto records the autotuned knee here)
+        f"{fam}_fleet_layout": (
+            buckets[0]["layout"] if buckets else "legacy"
+        ),
+        f"{fam}_fleet_autotuned_width": trainer.last_stats.get("width_cap"),
         f"{fam}_fleet_config": (
             f"{n_models} models x {rows} rows x {n_features} tags, {arch}, "
             + (f"lookback {lookback}, " if fam != "vae" else "")
             + f"{epochs} epochs, bf16"
         ),
     }
+    if fam == "lstm":
+        # layout A/B on THIS backend: the same fleet trained with the
+        # time-major gang scan vs the legacy vmap(member) nesting, each
+        # against the identical single-build baseline — BOTH paths'
+        # vs_single ratios land in BENCH_DETAIL so the 0.5x-pessimization
+        # headline (BENCH_TPU_20260731) stays comparable across PRs
+        from gordo_components_tpu.ops.seq_scan import (
+            SEQ_LAYOUT_ENV,
+            resolve_seq_kernel_mode,
+        )
+
+        out[f"{fam}_fleet_kernel"] = resolve_seq_kernel_mode()
+        default_layout = out[f"{fam}_fleet_layout"]
+        other = "legacy" if default_layout == "time_major" else "time_major"
+        prior = os.environ.get(SEQ_LAYOUT_ENV)
+        try:
+            os.environ[SEQ_LAYOUT_ENV] = other
+            FleetTrainer(**config).fit(members)  # warm the flipped programs
+            t0 = time.time()
+            FleetTrainer(**config).fit(members)
+            other_elapsed = time.time() - t0
+        finally:
+            if prior is None:
+                os.environ.pop(SEQ_LAYOUT_ENV, None)
+            else:
+                os.environ[SEQ_LAYOUT_ENV] = prior
+        other_rate = n_models / other_elapsed * 3600 / n_chips
+        by_layout = {
+            default_layout: (elapsed, fleet_rate),
+            other: (other_elapsed, other_rate),
+        }
+        for layout, (wall, rate) in by_layout.items():
+            out[f"{fam}_fleet_{layout}_wall_seconds"] = round(wall, 2)
+            out[f"{fam}_fleet_vs_single_same_arch_{layout}"] = round(
+                rate / single_rate, 1
+            )
+        tm_wall, _ = by_layout["time_major"]
+        leg_wall, _ = by_layout["legacy"]
+        out[f"{fam}_fleet_time_major_vs_legacy"] = round(leg_wall / tm_wall, 2)
     if fam == "conv":
+        # no recurrence, no recurrent-step kernel: conv's fast path is
+        # the matmul formulation A/B'd below
+        out[f"{fam}_fleet_kernel"] = "n/a"
         # conv-impl A/B on THIS backend: slice+matmul (the default since
         # 2026-07-31 — 3-16x faster for gangs, 5-8x for singles on CPU,
         # and the MXU-native formulation) vs the stock lax conv ops,

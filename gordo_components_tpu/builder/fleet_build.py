@@ -13,6 +13,7 @@ import copy
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -50,6 +51,50 @@ logger = logging.getLogger(__name__)
 # must degrade to a partial manifest, never abort the whole gang
 _FP_GROUP = faultpoint("fleet_build.group")
 
+# cross-arch gang scheduling (ISSUE 20): groups at or below this member
+# count are "small" — their wall time is dominated by host-side work
+# (tracing, compile, stack/unstack), so overlapping them pays; larger
+# groups saturate the device alone and stay serial
+GANG_SMALL_MAX = 32
+GANG_WIDTH_ENV = "GORDO_GANG_WIDTH"
+
+
+def resolve_gang_width(n_groups: int) -> int:
+    """Worker-thread count for the small-group gang scheduler. Env
+    ``GORDO_GANG_WIDTH``: an integer pins it; ``auto``/unset picks
+    min(4, n_groups) when more than one accelerator device is present
+    (overlap is free there) and 1 on a single-device host — the CPU test
+    rigs keep today's strictly serial, deterministic schedule unless a
+    test opts in explicitly."""
+    raw = (os.environ.get(GANG_WIDTH_ENV) or "auto").strip().lower()
+    if raw not in ("", "auto"):
+        width = int(raw)
+        if width < 1:
+            raise ValueError(f"{GANG_WIDTH_ENV} must be >= 1, got {width}")
+        return min(width, max(1, n_groups))
+    import jax
+
+    if jax.device_count() > 1 or jax.default_backend() in ("tpu", "gpu"):
+        return min(4, max(1, n_groups))
+    return 1
+
+
+class _LockedHeartbeat:
+    """Serializes heartbeat writes when gang worker threads report
+    concurrently — the state file update is read-modify-write."""
+
+    def __init__(self, hb):
+        self._hb = hb
+        self._lock = threading.Lock()
+
+    def update(self, **kw):
+        with self._lock:
+            self._hb.update(**kw)
+
+    def finish(self, *a, **kw):
+        with self._lock:
+            self._hb.finish(*a, **kw)
+
 
 class FleetBuildReport(Dict[str, str]):
     """``build_fleet``'s return value: name -> artifact dir, exactly the
@@ -65,6 +110,7 @@ class FleetBuildReport(Dict[str, str]):
         super().__init__(*args, **kwargs)
         self.failed: Dict[str, str] = {}
         self.group_retries: int = 0
+        self.gang_width: int = 1  # small-group scheduler width used
 
     def manifest(self) -> Dict[str, Any]:
         return {
@@ -74,6 +120,7 @@ class FleetBuildReport(Dict[str, str]):
             "n_built": len(self),
             "n_failed": len(self.failed),
             "group_retries": self.group_retries,
+            "gang_width": self.gang_width,
         }
 
 
@@ -508,7 +555,7 @@ def build_fleet(
             import jax
 
             gang_id = f"{gang_id}-host{jax.process_index()}"
-        heartbeat = GangHeartbeat(state_dir, gang_id)
+        heartbeat = _LockedHeartbeat(GangHeartbeat(state_dir, gang_id))
         heartbeat.update(
             phase="starting", n_machines=len(machines), built=0,
             distributed=bool(distributed),
@@ -569,7 +616,7 @@ def build_fleet(
                     (machine, ae_kwargs)
                 )
 
-        for _, group in fleet_groups.items():
+        def train_group(group):
             # per-group isolation with bounded retry: a poisoned hparam
             # group (bad LR diverging the whole stack, an injected fault,
             # an OOM at this bucket's batch shape) exhausts its retries,
@@ -589,7 +636,7 @@ def build_fleet(
                             mesh=trainer_mesh,
                             heartbeat=heartbeat, counters=counters,
                         )
-                    break
+                    return
                 except Exception as exc:
                     if attempt < group_retries:
                         results.group_retries += 1
@@ -611,6 +658,39 @@ def build_fleet(
                         "manifest; remaining groups continue: %s",
                         len(group), group_retries + 1, error, exc_info=True,
                     )
+
+        # cross-arch gang scheduling: LARGE groups saturate the device on
+        # their own and train one at a time, but a tail of SMALL
+        # heterogeneous groups (different archs -> different compiled
+        # programs, no shared vmap possible) would otherwise issue one
+        # tiny dispatch each with the device idle during every group's
+        # host-side work (tracing, XLA compile, stacking, unstacking).
+        # GORDO_GANG_WIDTH worker threads drive those groups concurrently:
+        # JAX dispatch is thread-safe, device work interleaves in the
+        # queue, and group A's compile overlaps group B's compute. Results
+        # are per-member (distinct keys per group), heartbeat writes are
+        # serialized below, and the fleet program cache takes its own lock.
+        gang_width = resolve_gang_width(len(fleet_groups))
+        serial = [
+            g for g in fleet_groups.values() if len(g) > GANG_SMALL_MAX
+        ]
+        small = [
+            g for g in fleet_groups.values() if len(g) <= GANG_SMALL_MAX
+        ]
+        results.gang_width = gang_width
+        for group in serial:
+            train_group(group)
+        if gang_width > 1 and len(small) > 1:
+            import concurrent.futures as _futures
+
+            with _futures.ThreadPoolExecutor(
+                max_workers=gang_width, thread_name_prefix="gordo-gang"
+            ) as pool:
+                for f in [pool.submit(train_group, g) for g in small]:
+                    f.result()  # train_group never raises; surface bugs
+        else:
+            for group in small:
+                train_group(group)
     except BaseException as exc:
         # only non-build failures (preemption signals, a broken state
         # volume, bugs outside the isolated paths) land here now

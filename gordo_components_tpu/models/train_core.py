@@ -281,6 +281,103 @@ def make_seq_train_fns(
     return init_fn, epoch_fn
 
 
+def make_seq_gang_epoch(
+    module,
+    optimizer: optax.GradientTransformation,
+    batch_size: int,
+    lookback: int,
+    target_offset: int = 0,
+):
+    """Time-major GANG epoch: the whole member axis trains in one
+    non-vmapped program whose recurrent scan keeps members innermost
+    (ops/seq_scan.py) — ``vmap(epoch_fn)``'s fast-path replacement for
+    LSTM buckets.
+
+    ``epoch_fn(states, X, mask) -> (states, (M,) losses)`` over STACKED
+    state (leading member axis), X: (M, rows_pad, f), mask: (M,
+    items_pad). Per-member semantics are the legacy path's exactly:
+
+    - the shuffle/rng plan is ``make_seq_train_fns``'s byte-for-byte
+      (same three splits + fold_in per batch, vmapped per member), so
+      every member sees the identical batch sequence;
+    - the loss is the per-member masked mean; gradients come from the
+      SUM of member losses, which decouples exactly (each member's loss
+      depends only on its own parameter rows);
+    - the optimizer update and the all-padding-batch no-op guard are
+      vmapped per member — elementwise work, not the hot loop.
+
+    The one intentional difference is the forward: the time-major scan
+    re-associates the gate matmuls, so parity with the legacy layout is
+    fp32-rounding-level, not bitwise (band pinned by
+    tests/test_seq_fastpath.py). MSE only — the gang loss needs the
+    member-explicit forward, which the variational heads don't have.
+    """
+    from gordo_components_tpu.ops.seq_scan import lstm_time_major_forward
+
+    def epoch_fn(states: TrainState, X, mask):
+        M, n_pad = mask.shape
+        n_batches = n_pad // batch_size
+
+        def plan(rng, m):
+            rng2, perm_rng, batch_base = jax.random.split(rng, 3)
+            rngs = jax.vmap(lambda i: jax.random.fold_in(batch_base, i))(
+                jnp.arange(n_batches)
+            )
+            keys = jax.random.uniform(perm_rng, (n_pad,))
+            perm = jnp.argsort(jnp.where(m > 0, keys, 2.0))
+            return rng2, perm, rngs
+
+        rng2, perms, rngss = jax.vmap(plan)(states.rng, mask)
+        # batch-major so the scan slices one (M, batch) block per step
+        idxs = perms.reshape((M, n_batches, batch_size)).transpose(1, 0, 2)
+        Ms = (
+            jnp.take_along_axis(mask, perms, axis=1)
+            .reshape((M, n_batches, batch_size))
+            .transpose(1, 0, 2)
+        )
+
+        def step(carry, batch):
+            params, opt_state = carry
+            ib, mb = batch
+            xb, yb = jax.vmap(
+                gather_window_batch, in_axes=(0, 0, None, None)
+            )(X, ib, lookback, target_offset)
+
+            def gang_loss(p):
+                preds = lstm_time_major_forward(module, p, xb, kernel="jnp")
+                losses = jax.vmap(mse_loss)(preds, yb, mb)
+                return jnp.sum(losses), losses
+
+            grads, losses = jax.grad(gang_loss, has_aux=True)(params)
+            updates, new_opt = jax.vmap(optimizer.update)(
+                grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            has_real = jnp.sum(mb, axis=1) > 0  # (M,)
+
+            def keep(n, o):
+                hr = has_real.reshape((M,) + (1,) * (n.ndim - 1))
+                return jnp.where(hr, n, o)
+
+            return (
+                jax.tree.map(keep, new_params, params),
+                jax.tree.map(keep, new_opt, opt_state),
+            ), (losses, jnp.sum(mb, axis=1))
+
+        (params, opt_state), (losses, counts) = jax.lax.scan(
+            step, (states.params, states.opt_state), (idxs, Ms)
+        )
+        mean_loss = jnp.sum(losses * counts, axis=0) / jnp.maximum(
+            jnp.sum(counts, axis=0), 1.0
+        )
+        return (
+            TrainState(params=params, opt_state=opt_state, rng=rng2),
+            mean_loss,
+        )
+
+    return epoch_fn
+
+
 def make_seq_eval_fn(
     module,
     batch_size: int,

@@ -97,19 +97,33 @@ def dense_chain_flops(n_features: int, encoding_dim, decoding_dim) -> float:
     return float(sum(2 * a * b for a, b in zip(dims, dims[1:])))
 
 
-def lstm_stack_flops(n_features: int, dims, lookback: int) -> float:
-    """Forward FLOPs for one WINDOW (``lookback`` timesteps) through an
-    LSTMStack: each layer runs its cell over the full sequence (the
-    output sequence feeds the next layer), then the last step goes
-    through a Dense back to n_features. An LSTM cell step is 4 gates of
-    (in + hidden)·hidden matmuls: 8·h·(in + h) FLOPs."""
-    dims = [int(d) for d in dims]
+def lstm_step_flops(n_features: int, dims) -> float:
+    """FLOPs for ONE recurrent scan step through every layer of an
+    LSTMStack: 4 gates of (in + hidden)·hidden matmuls per cell, i.e.
+    8·h·(in + h) per layer. This is the scan-trip unit both layouts
+    execute — the legacy vmap(member)-outside-RNN nesting and the
+    time-major gang scan (ops/seq_scan.py) run IDENTICAL math per step;
+    the layouts differ only in which axis the matmul batches over, so
+    the closed form is layout-invariant by construction."""
     per_step = 0.0
     prev = int(n_features)
-    for h in dims:
+    for h in (int(d) for d in dims):
         per_step += 8.0 * h * (prev + h)
         prev = h
-    return float(lookback) * per_step + 2.0 * dims[-1] * int(n_features)
+    return per_step
+
+
+def lstm_stack_flops(n_features: int, dims, lookback: int) -> float:
+    """Forward FLOPs for one WINDOW through an LSTMStack: exactly
+    ``lookback`` scan trips of :func:`lstm_step_flops` (the time-major
+    path makes the trip count explicit — one ``lax.scan`` of length
+    ``lookback``; the legacy flax RNN runs the same count per layer),
+    then the last step's Dense head back to n_features."""
+    dims = [int(d) for d in dims]
+    return (
+        float(lookback) * lstm_step_flops(n_features, dims)
+        + 2.0 * dims[-1] * int(n_features)
+    )
 
 
 def conv1d_autoencoder_flops(
@@ -119,7 +133,11 @@ def conv1d_autoencoder_flops(
     stride-2 SAME encoder convs (length ceil-halves per layer), stride-2
     transposed decoder convs over reversed channels (length doubles),
     and a final stride-1 full-length conv back to n_features. A conv
-    layer is 2·out_len·K·in_ch·out_ch."""
+    layer is 2·out_len·K·in_ch·out_ch. Impl-invariant: the fleet's
+    default matmul formulation (K strided slices, one matmul each —
+    models/factories/conv.py) performs exactly these multiply-adds, just
+    batched lane-friendly, so one closed form covers both
+    ``conv_impl`` paths."""
     channels = [int(c) for c in channels]
     k = int(kernel_size)
     total = 0.0

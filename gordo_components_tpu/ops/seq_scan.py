@@ -1,0 +1,349 @@
+"""Time-major sequence fast path: scan-over-time with the member axis
+innermost.
+
+The fleet engine's original recurrent layout nests ``vmap`` (member axis)
+OUTSIDE ``flax.linen.RNN`` (``lax.scan`` inside): every scan step issues M
+interleaved small matmuls whose lane dimension is one member's hidden
+width. The TPU bench (BENCH_TPU_20260731) measured that layout at 0.5x the
+per-model throughput of training members one at a time — vmap-over-members
+is a *pessimization* for recurrent architectures.
+
+This module inverts the nesting. One ``lax.scan`` over time; the carry and
+activations keep members as the INNERMOST (lane-friendly) axis:
+
+- inputs arrive member-major ``(M, B, T, F)`` (the fleet's stacking order)
+  and are transposed ONCE to time-major ``(T, B, M, F)``;
+- the input projection for ALL timesteps is hoisted out of the scan as one
+  wide einsum per layer (``tbmf,mfg->tbmg``);
+- each scan step is a single batched matmul ``bmh,mhg->bmg`` plus the gate
+  nonlinearities and carry update.
+
+Weight extraction targets ``flax.linen.OptimizedLSTMCell``'s param tree
+(separate per-gate kernels ``ii/if/ig/io`` and ``hi/hf/hg/ho``, bias on the
+hidden half only); gate math is the flax cell's exactly::
+
+    z = x @ Wi + h @ Wh + b          # gate order i, f, g, o
+    c' = sigmoid(f) * c + sigmoid(i) * tanh(g)
+    h' = sigmoid(o) * tanh(c')
+
+so the time-major forward matches ``vmap(module.apply)`` to fp32 rounding
+(matmul re-association only — the parity band is pinned by
+tests/test_seq_fastpath.py).
+
+Two env knobs, resolved ONCE per compiled program (never per call):
+
+- ``GORDO_SEQ_LAYOUT`` = ``auto|time_major|legacy``. ``auto`` picks
+  ``time_major`` on TPU/GPU backends and ``legacy`` on CPU: the layout win
+  is a lane-utilization effect, and keeping single-device CPU on the
+  legacy path preserves the byte-for-byte fleet-vs-single guarantees the
+  CPU test suite pins (tests opt in explicitly).
+- ``GORDO_SEQ_KERNEL`` = ``auto|pallas|interpret|jnp``: the fused
+  recurrent-step kernel below (gate matmul + nonlinearities + carry update
+  in one VMEM pass per step), ``GORDO_BANK_KERNEL``-style resolution with
+  interpret mode as CI's parity vehicle. The kernel is FORWARD-ONLY: it
+  serves the bank's compiled scoring programs; training keeps the jnp step
+  (its backward comes from autodiff through the scan — a custom VJP for
+  the fused step is future work, see docs/architecture.md).
+"""
+
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+SEQ_LAYOUT_ENV = "GORDO_SEQ_LAYOUT"
+SEQ_KERNEL_ENV = "GORDO_SEQ_KERNEL"
+_SEQ_LAYOUTS = ("auto", "time_major", "legacy")
+_SEQ_KERNEL_MODES = ("auto", "pallas", "interpret", "jnp")
+
+_GATES = ("i", "f", "g", "o")  # flax OptimizedLSTMCell split order
+LANE = 128  # TPU lane width (f32)
+SUBLANE = 8
+
+
+def _fast_backend() -> bool:
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def resolve_seq_layout(mode: str = None) -> str:
+    """Concrete layout for sequence fleet programs: ``mode`` (or env
+    ``GORDO_SEQ_LAYOUT``, default ``auto``) resolved against the backend.
+    Resolved once per program build — the layout is baked into the
+    bucket's compiled epoch/scoring program, not re-decided per call."""
+    raw = (mode or os.environ.get(SEQ_LAYOUT_ENV) or "auto").strip().lower()
+    if raw not in _SEQ_LAYOUTS:
+        raise ValueError(
+            f"{SEQ_LAYOUT_ENV} must be one of {'|'.join(_SEQ_LAYOUTS)}, "
+            f"got {raw!r}"
+        )
+    if raw == "auto":
+        return "time_major" if _fast_backend() else "legacy"
+    return raw
+
+
+_step_probe_ok = None
+
+
+def _probe_step_kernel() -> bool:
+    """One tiny compile of the fused step, cached per process — the
+    recurrent analogue of pallas_score's banked probe: auto mode must
+    never bake a kernel that cannot compile into a scoring program."""
+    global _step_probe_ok
+    if _step_probe_ok is None:
+        try:
+            out = fused_lstm_step(
+                jnp.zeros((8, 1, 4 * LANE), jnp.float32),
+                jnp.zeros((8, 1, LANE), jnp.float32),
+                jnp.zeros((8, 1, LANE), jnp.float32),
+                jnp.zeros((1, LANE, 4 * LANE), jnp.float32),
+                jnp.zeros((1, 4 * LANE), jnp.float32),
+            )
+            jax.block_until_ready(out)
+            _step_probe_ok = True
+        except Exception:
+            _step_probe_ok = False
+            logger.warning(
+                "Fused LSTM-step Pallas kernel failed to compile on backend "
+                "%r; scoring programs built in auto mode use the jnp step "
+                "for the rest of this process (GORDO_SEQ_KERNEL=pallas to "
+                "surface the error)",
+                jax.default_backend(),
+                exc_info=True,
+            )
+    return _step_probe_ok
+
+
+def resolve_seq_kernel_mode(mode: str = None) -> str:
+    """Dispatch mode for the fused recurrent-step kernel (scoring path):
+    ``mode`` (or env ``GORDO_SEQ_KERNEL``, default ``auto``) resolved once
+    per program build. ``auto`` on TPU probe-compiles first and degrades
+    to jnp if the probe fails; an explicit ``pallas`` never degrades."""
+    raw = (mode or os.environ.get(SEQ_KERNEL_ENV) or "auto").strip().lower()
+    if raw not in _SEQ_KERNEL_MODES:
+        raise ValueError(
+            f"{SEQ_KERNEL_ENV} must be one of {'|'.join(_SEQ_KERNEL_MODES)}, "
+            f"got {raw!r}"
+        )
+    if raw == "auto":
+        return (
+            "pallas"
+            if jax.default_backend() == "tpu" and _probe_step_kernel()
+            else "jnp"
+        )
+    return raw
+
+
+def supports_time_major(module) -> bool:
+    """Duck-typed: the time-major forward understands exactly the
+    LSTMStack architecture (per-layer ``OptimizedLSTMCell`` + elementwise
+    activation, final-step Dense head). Anything else — conv (no
+    recurrence; its fast path is the matmul formulation), VAE heads,
+    custom modules — stays on the legacy layout."""
+    return all(
+        hasattr(module, a) for a in ("dims", "funcs", "out_func", "n_features")
+    ) and not hasattr(module, "channels")
+
+
+def extract_lstm_weights(module, params):
+    """Per-layer ``(Wi, Wh, b)`` + Dense head from an LSTMStack param tree.
+
+    Works on a single tree or a member-stacked one (leading M axis on
+    every leaf): per-gate kernels concatenate on the LAST axis in flax's
+    ``i, f, g, o`` split order, so each gate's output columns are the
+    same dot products the cell computes — parity is limited only by
+    accumulation order.
+
+    Returns ``(layers, (Wd, bd))`` with ``layers[l] = (Wi, Wh, b)`` of
+    shapes ``([M,] F_in, 4H)``, ``([M,] H, 4H)``, ``([M,] 4H)``.
+    """
+    p = params["params"] if "params" in params else params
+    layers = []
+    for l in range(len(module.dims)):
+        cell = p[f"OptimizedLSTMCell_{l}"]
+        Wi = jnp.concatenate(
+            [cell[f"i{g}"]["kernel"] for g in _GATES], axis=-1
+        )
+        Wh = jnp.concatenate(
+            [cell[f"h{g}"]["kernel"] for g in _GATES], axis=-1
+        )
+        b = jnp.concatenate([cell[f"h{g}"]["bias"] for g in _GATES], axis=-1)
+        layers.append((Wi, Wh, b))
+    head = p["Dense_0"]
+    return layers, (head["kernel"], head["bias"])
+
+
+def _lstm_gates(z, c):
+    """flax OptimizedLSTMCell carry update from the fused gate block."""
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+    return c2, h2
+
+
+def lstm_step_jnp(xz_t, h, c, Wh, b):
+    """One recurrent step, member axis innermost. xz_t: (B, M, 4H)
+    precomputed input projection; h/c: (B, M, H); Wh: (M, H, 4H);
+    b: (M, 4H). Returns (c', h')."""
+    z = xz_t + jnp.einsum("bmh,mhg->bmg", h, Wh) + b[None]
+    return _lstm_gates(z, c)
+
+
+# ------------------------------------------------------------------ #
+# Fused recurrent-step Pallas kernel (forward/scoring only)
+# ------------------------------------------------------------------ #
+
+
+def _step_kernel(xz_ref, h_ref, c_ref, wh_ref, b_ref, c2_ref, h2_ref):
+    """Grid step = one member: gate matmul + nonlinearities + carry update
+    in a single VMEM pass — the recurrent analogue of pallas_score's
+    banked grid. Blocks carry a singleton member axis (B, 1, ·)."""
+    z = (
+        xz_ref[:, 0, :]
+        + jnp.dot(h_ref[:, 0, :], wh_ref[0], preferred_element_type=jnp.float32)
+        + b_ref[0][None, :]
+    )
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = c_ref[:, 0, :]
+    c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+    c2_ref[:, 0, :] = c2
+    h2_ref[:, 0, :] = h2
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_lstm_step(xz_t, h, c, Wh, b, interpret: bool = False):
+    """Pallas fused step with the same signature/layout as
+    :func:`lstm_step_jnp` (member axis innermost, H already padded to the
+    lane tile by :func:`pad_gate_lanes`). Returns (c', h')."""
+    from jax.experimental import pallas as pl
+
+    B, M, H4 = xz_t.shape
+    H = H4 // 4
+    grid = (M,)
+    blk_h = pl.BlockSpec((B, 1, H), lambda m: (0, m, 0))
+    blk_z = pl.BlockSpec((B, 1, H4), lambda m: (0, m, 0))
+    return pl.pallas_call(
+        _step_kernel,
+        grid=grid,
+        in_specs=[
+            blk_z,
+            blk_h,
+            blk_h,
+            pl.BlockSpec((1, H, H4), lambda m: (m, 0, 0)),
+            pl.BlockSpec((1, H4), lambda m: (m, 0)),
+        ],
+        out_specs=[blk_h, blk_h],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, M, H), xz_t.dtype),
+            jax.ShapeDtypeStruct((B, M, H), xz_t.dtype),
+        ],
+        interpret=interpret,
+    )(xz_t, h, c, Wh, b)
+
+
+def _round_up(n: int, k: int) -> int:
+    return -(-n // k) * k
+
+
+def pad_gate_lanes(Wh, b, H: int, Hp: int):
+    """Pad the hidden width to the lane tile GATE-ALIGNED: the fused gate
+    block splits into four H-wide slices, so padding must go inside each
+    gate's slice (zero kernel columns/rows and zero bias), not at the
+    end. Padded lanes stay self-contained: their z is exactly 0, the
+    resulting 0.5-sigmoid garbage multiplies only zero Wh rows on the
+    next step, and the caller slices them off the final hidden state."""
+    if Hp == H:
+        return Wh, b
+    pad_in = Hp - H
+
+    def per_gate(a, axis):
+        parts = jnp.split(a, 4, axis=-1)
+        widths = [(0, 0)] * a.ndim
+        widths[-1] = (0, pad_in)
+        parts = [jnp.pad(x, widths) for x in parts]
+        return jnp.concatenate(parts, axis=-1)
+
+    Wh = per_gate(Wh, -1)
+    rw = [(0, 0)] * Wh.ndim
+    rw[-2] = (0, pad_in)
+    Wh = jnp.pad(Wh, rw)
+    b = per_gate(b, -1)
+    return Wh, b
+
+
+# ------------------------------------------------------------------ #
+# Full time-major forward
+# ------------------------------------------------------------------ #
+
+
+def _lstm_layer(x, Wi, Wh, b, kernel: str):
+    """One LSTM layer over time-major x: (T, B, M, F_in) -> (T, B, M, H).
+
+    The input projection for ALL timesteps is one wide einsum hoisted out
+    of the scan; each scan step is then a single batched matmul + gates.
+    """
+    T, B, M, _ = x.shape
+    H = Wh.shape[-2]
+    xz = jnp.einsum("tbmf,mfg->tbmg", x, Wi)
+    if kernel in ("pallas", "interpret"):
+        Hp = _round_up(H, LANE)
+        Whp, bp = pad_gate_lanes(Wh, b, H, Hp)
+        Bp = _round_up(B, SUBLANE)
+        if Hp != H:
+            parts = jnp.split(xz, 4, axis=-1)
+            parts = [
+                jnp.pad(p, ((0, 0), (0, 0), (0, 0), (0, Hp - H)))
+                for p in parts
+            ]
+            xz = jnp.concatenate(parts, axis=-1)
+        if Bp != B:
+            xz = jnp.pad(xz, ((0, 0), (0, Bp - B), (0, 0), (0, 0)))
+        interpret = kernel == "interpret"
+
+        def step(carry, xz_t):
+            c, h = carry
+            c2, h2 = fused_lstm_step(xz_t, h, c, Whp, bp, interpret=interpret)
+            return (c2, h2), h2
+
+        zeros = jnp.zeros((Bp, M, Hp), x.dtype)
+        _, ys = jax.lax.scan(step, (zeros, zeros), xz)
+        return ys[:, :B, :, :H]
+
+    def step(carry, xz_t):
+        c, h = carry
+        c2, h2 = lstm_step_jnp(xz_t, h, c, Wh, b)
+        return (c2, h2), h2
+
+    zeros = jnp.zeros((B, M, H), x.dtype)
+    _, ys = jax.lax.scan(step, (zeros, zeros), xz)
+    return ys
+
+
+def lstm_time_major_forward(module, stacked_params, xb, kernel: str = "jnp"):
+    """Time-major LSTMStack forward over member-stacked params.
+
+    ``xb``: (M, B, T, F) — each member's batch of windows (the fleet's
+    stacking order; the bank's scoring path passes (slots, windows, L, F)).
+    Returns (M, B, F) predictions matching ``vmap(module.apply)`` to fp32
+    rounding. ``kernel`` must already be RESOLVED (jnp|pallas|interpret) —
+    training callers pass "jnp" (the fused kernel is forward-only)."""
+    from gordo_components_tpu.models.factories.feedforward import (
+        resolve_activation,
+    )
+
+    dtype = jnp.dtype(getattr(module, "compute_dtype", "float32"))
+    layers, (Wd, bd) = extract_lstm_weights(module, stacked_params)
+    x = jnp.transpose(xb, (2, 1, 0, 3)).astype(dtype)  # (T, B, M, F)
+    for (Wi, Wh, b), func in zip(layers, module.funcs):
+        x = _lstm_layer(
+            x, Wi.astype(dtype), Wh.astype(dtype), b.astype(dtype), kernel
+        )
+        x = resolve_activation(func)(x)
+    h_last = x[-1]  # (B, M, H) — final hidden state of the last layer
+    out = jnp.einsum("bmh,mhf->bmf", h_last, Wd.astype(dtype))
+    out = resolve_activation(module.out_func)(out + bd.astype(dtype)[None])
+    return jnp.transpose(out, (1, 0, 2)).astype(jnp.float32)

@@ -24,6 +24,7 @@ and the client treat fleet-trained models identically to single builds.
 
 import functools
 import logging
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -37,12 +38,17 @@ from gordo_components_tpu.models import train_core
 from gordo_components_tpu.models.register import lookup_factory
 from gordo_components_tpu.observability import get_registry
 from gordo_components_tpu.observability.tracing import current_trace
+from gordo_components_tpu.ops.seq_scan import (
+    resolve_seq_layout,
+    supports_time_major,
+)
 from gordo_components_tpu.ops.scaler import (
     ScalerParams,
     fit_minmax,
     fit_standard,
     scaler_transform,
 )
+from gordo_components_tpu.parallel.autotune import resolve_fleet_width
 from gordo_components_tpu.parallel.mesh import (
     MODEL_AXIS,
     fleet_mesh,
@@ -108,7 +114,9 @@ def _merge_best(best_p, new_p, improved):
 # scaled-feature axis), with (f+1)*8192 int32 histogram cells per member.
 _QUANTILE_BINS = 8192
 # Transient histogram budget for one vmapped quantile pass; wider fleets
-# stream through run_error_scalers in member chunks under this cap.
+# stream through run_error_scalers in member chunks under this cap — in
+# particular at GORDO_FLEET_WIDTH=auto's 4096-member knee, where the
+# un-chunked carry would be 4096*(f+1)*32KB of pure transient.
 _QUANTILE_CHUNK_BYTES = 1 << 28
 
 
@@ -149,10 +157,15 @@ class _BucketPrograms:
     def __init__(
         self, module, opt_name: str, lr: float, batch_size: int, seq=None,
         loss: str = "mse", kl_weight: float = 1.0,
-        threshold_quantile: float = 1.0,
+        threshold_quantile: float = 1.0, layout: str = "legacy",
     ):
         self.module = module
         self.seq = seq
+        # the RESOLVED sequence layout (ops/seq_scan.resolve_seq_layout,
+        # resolved by _bucket_programs so it is part of the cache key):
+        # "time_major" routes run_epoch/chunk_fn through the gang epoch
+        # whose scan keeps members innermost; "legacy" is vmap(epoch).
+        self.layout = layout if seq is not None else "legacy"
         # inject=True: the learning rate lives in the (vmapped, stacked)
         # opt state, so _fit_bucket can overwrite it with a per-member
         # (M,) vector — members differing only in LR share this program
@@ -176,8 +189,27 @@ class _BucketPrograms:
             )
             return merged, jnp.where(active > 0, loss, jnp.nan)
 
-        self._vm_epoch = jax.vmap(masked_epoch)
-        self.run_epoch = jax.jit(jax.vmap(masked_epoch), donate_argnums=(0,))
+        if self.layout == "time_major":
+            gang_epoch = train_core.make_seq_gang_epoch(
+                module, optimizer, batch_size, seq[0], seq[1]
+            )
+
+            def masked_gang(states, X, mask, active):
+                new_states, losses = gang_epoch(states, X, mask)
+                act = active > 0
+
+                def sel(n, o):
+                    return jnp.where(
+                        act.reshape(act.shape + (1,) * (n.ndim - 1)), n, o
+                    )
+
+                merged = jax.tree.map(sel, new_states, states)
+                return merged, jnp.where(act, losses, jnp.nan)
+
+            self._vm_epoch = masked_gang
+        else:
+            self._vm_epoch = jax.vmap(masked_epoch)
+        self.run_epoch = jax.jit(self._vm_epoch, donate_argnums=(0,))
 
         # per-member validation loss, same loss family and masked-mean
         # semantics as the single path's make_eval_fn. One deliberate
@@ -560,6 +592,10 @@ _PROGRAM_CACHE_MAX = 128
 # monotone count of _BucketPrograms builds: lets tests (and operators
 # debugging recompile storms) assert whether a fit hit the cache
 _PROGRAM_BUILDS = 0
+# the builder's gang scheduler (builder/fleet_build.py) trains small
+# groups from worker threads; the shared LRU needs a lock (jit/tracing
+# themselves are thread-safe)
+_PROGRAM_LOCK = threading.Lock()
 
 
 def _count_program_build() -> None:
@@ -578,31 +614,40 @@ def _bucket_programs(
     module, opt_name: str, lr: float, batch_size: int, seq=None,
     loss: str = "mse", kl_weight: float = 1.0, threshold_quantile: float = 1.0,
 ) -> _BucketPrograms:
+    # the sequence layout is resolved HERE (not inside _BucketPrograms) so
+    # it participates in the cache key — flipping GORDO_SEQ_LAYOUT between
+    # fits must never return a program compiled for the other layout. The
+    # gang epoch understands exactly the LSTMStack/mse combination;
+    # everything else stays on the legacy vmapped layout.
+    layout = "legacy"
+    if seq is not None and loss == "mse" and supports_time_major(module):
+        layout = resolve_seq_layout()
     key = (
         module, opt_name, float(lr), int(batch_size), seq, loss,
-        float(kl_weight), float(threshold_quantile),
+        float(kl_weight), float(threshold_quantile), layout,
     )
-    try:
-        prog = _PROGRAM_CACHE.get(key)
-    except TypeError:  # unhashable factory kwargs: build uncached
-        _count_program_build()
-        return _BucketPrograms(
-            module, opt_name, lr, batch_size, seq, loss, kl_weight,
-            threshold_quantile,
-        )
-    if prog is None:
-        # LRU bound: a long-lived gang builder cycling many configs keeps
-        # its hot programs warm instead of recompiling everything from zero
-        # after a wholesale wipe
-        while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
-            _PROGRAM_CACHE.popitem(last=False)
-        _count_program_build()
-        prog = _PROGRAM_CACHE[key] = _BucketPrograms(
-            module, opt_name, lr, batch_size, seq, loss, kl_weight,
-            threshold_quantile,
-        )
-    else:
-        _PROGRAM_CACHE.move_to_end(key)
+    with _PROGRAM_LOCK:
+        try:
+            prog = _PROGRAM_CACHE.get(key)
+        except TypeError:  # unhashable factory kwargs: build uncached
+            _count_program_build()
+            return _BucketPrograms(
+                module, opt_name, lr, batch_size, seq, loss, kl_weight,
+                threshold_quantile, layout,
+            )
+        if prog is None:
+            # LRU bound: a long-lived gang builder cycling many configs
+            # keeps its hot programs warm instead of recompiling everything
+            # from zero after a wholesale wipe
+            while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+                _PROGRAM_CACHE.popitem(last=False)
+            _count_program_build()
+            prog = _PROGRAM_CACHE[key] = _BucketPrograms(
+                module, opt_name, lr, batch_size, seq, loss, kl_weight,
+                threshold_quantile, layout,
+            )
+        else:
+            _PROGRAM_CACHE.move_to_end(key)
     return prog
 
 
@@ -799,6 +844,7 @@ class FleetTrainer:
                 f"threshold_quantile must be in [0, 1], got {threshold_quantile}"
             )
         self.require_thresholds = bool(require_thresholds)
+        self._bucket_layout = "legacy"  # layout of the last-built bucket
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
@@ -956,6 +1002,25 @@ class FleetTrainer:
             key = (X.shape[1], n_batches * self.batch_size)
             buckets.setdefault(key, []).append(name)
 
+        # ---- member-width cap (parallel/autotune.py): GORDO_FLEET_WIDTH
+        # splits oversized gangs into near-equal chunks no wider than the
+        # cap. Chunks share the bucket's compiled program whenever their
+        # quantized member counts agree (quantize_member_count makes
+        # near-equal chunk sizes land on the same ladder rung). NOTE: the
+        # split changes each member's position in its gang, which reseeds
+        # its init rng — capped runs train valid models, not bitwise the
+        # uncapped ones.
+        width_cap = resolve_fleet_width(f"{self.model_type}:{self.kind}")
+        work: List[Tuple[Tuple[int, int], List[str]]] = []
+        for key, names in sorted(buckets.items()):
+            if width_cap and len(names) > width_cap:
+                n_chunks = -(-len(names) // width_cap)
+                size = -(-len(names) // n_chunks)
+                for i in range(0, len(names), size):
+                    work.append((key, names[i : i + size]))
+            else:
+                work.append((key, names))
+
         out: Dict[str, FleetMemberModel] = {}
         bucket_stats = []
         self._g_members_total.set(len(members))
@@ -965,7 +1030,7 @@ class FleetTrainer:
         # span with ``compile``/``checkpoint`` children — the builder-side
         # counterpart of the serving stage spans
         trace = current_trace()
-        for (n_features, padded_rows), names in sorted(buckets.items()):
+        for (n_features, padded_rows), names in work:
             tb = time.time()
             blabel = f"f{n_features}x{padded_rows}"
             self._active_ckpt = None
@@ -1053,12 +1118,18 @@ class FleetTrainer:
                     # structured per-epoch timing: epoch 0 includes the XLA
                     # compile, steady-state is the rest
                     "epoch_seconds": epoch_seconds,
+                    # which sequence layout the bucket's epoch program used
+                    # ("time_major" = gang scan, members innermost;
+                    # "legacy" = vmap(epoch); dense buckets are always
+                    # legacy) — resolved per program, recorded per bucket
+                    "layout": self._bucket_layout,
                 }
             )
         self.last_stats = {
             "total_seconds": time.time() - t0,
             "n_members": len(members),
             "buckets": bucket_stats,
+            "width_cap": width_cap,
         }
         return out
 
@@ -1154,6 +1225,7 @@ class FleetTrainer:
             min(bs, padded_items), seq, loss, self.kl_weight,
             self.threshold_quantile,
         )
+        self._bucket_layout = progs.layout
         init_stacked = progs.init_stacked
         run_epoch = progs.run_epoch
 

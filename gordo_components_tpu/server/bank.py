@@ -64,6 +64,12 @@ from gordo_components_tpu.ops.quantize import (
     tree_weight_bytes,
 )
 from gordo_components_tpu.ops.scaler import ScalerParams
+from gordo_components_tpu.ops.seq_scan import (
+    lstm_time_major_forward,
+    resolve_seq_kernel_mode,
+    resolve_seq_layout,
+    supports_time_major,
+)
 from gordo_components_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from gordo_components_tpu.resilience.faults import faultpoint
 from gordo_components_tpu.server.arena import PaddedArena
@@ -308,6 +314,9 @@ class _Bucket:
         self.flops_per_row = 0.0
         self.flops_method = "unknown"
         self.params_per_member = 0
+        # sequence fast-path provenance, resolved by finalize()
+        self.seq_layout = "legacy"
+        self.seq_kernel = "jnp"
 
     @property
     def offset(self) -> int:
@@ -382,6 +391,41 @@ class _Bucket:
         lookback, t_off, off = self.lookback, self.target_offset, self.offset
         dequant = self.effective_dtype != "float32"
         kernel_mode = self.kernel_mode
+        # sequence fast path (ops/seq_scan.py): LSTM buckets can score
+        # through the time-major scan — batch slots become the member
+        # axis, kept innermost — with the fused recurrent-step kernel
+        # when GORDO_SEQ_KERNEL resolves to it. Resolved ONCE here (like
+        # kernel_mode): the choice is baked into the compiled program.
+        use_tm = (
+            resolve_seq_layout() == "time_major"
+            and lookback > 1
+            and supports_time_major(module)
+        )
+        self.seq_layout = "time_major" if use_tm else "legacy"
+        self.seq_kernel = resolve_seq_kernel_mode() if use_tm else "jnp"
+        seq_kernel = self.seq_kernel
+        if use_tm:
+            self.flops_method += f":time_major(T={lookback})"
+
+        def forward_tm(params, in_shift, in_scale, idx, X, Y):
+            # idx: (B,) int32; X/Y: (B, T, F) raw-space. One gather
+            # stacks every slot's member params; one scan over time
+            # scores all slots' windows with the slot axis innermost.
+            from gordo_components_tpu.ops.windows import sliding_windows
+
+            p = jax.tree.map(lambda a: a[idx], params)
+            if dequant:
+                p = dequantize_params(p)
+            sh = in_shift[idx][:, None, :]
+            sc = in_scale[idx][:, None, :]
+            xs = (X - sh) * sc
+            ys = (Y - sh) * sc
+            W = jax.vmap(lambda x: sliding_windows(x, lookback))(xs)
+            if t_off:
+                W = W[:, :-t_off]
+            recon = lstm_time_major_forward(module, p, W, kernel=seq_kernel)
+            target = ys[:, off : off + recon.shape[1]]
+            return recon, target
 
         def forward(params, in_shift, in_scale, i, x, y):
             # i: () int32 into the (local) stack; x/y: (T, F) raw-space;
@@ -416,9 +460,16 @@ class _Bucket:
                 # batch in one banked pass — the Pallas kernel's
                 # (member, row-tile) grid on TPU, identical jnp math
                 # elsewhere (ops/pallas_score.banked_anomaly_score)
-                recon, target = jax.vmap(
-                    lambda i, x, y: forward(params, in_shift, in_scale, i, x, y)
-                )(idx, X, Y)
+                if use_tm:
+                    recon, target = forward_tm(
+                        params, in_shift, in_scale, idx, X, Y
+                    )
+                else:
+                    recon, target = jax.vmap(
+                        lambda i, x, y: forward(
+                            params, in_shift, in_scale, i, x, y
+                        )
+                    )(idx, X, Y)
                 diff, scaled, tot_u, tot_s = banked_anomaly_score(
                     target, recon, err_shift, err_scale, idx, mode=kernel_mode
                 )
@@ -441,9 +492,14 @@ class _Bucket:
                 # per device on the local sub-batch with the LOCAL scaler
                 # stack — the gather indices are already shard-local.
                 def local(p, ish, isc, esh, esc, i, x, y):
-                    recon, target = jax.vmap(
-                        lambda ii, xx, yy: forward(p, ish, isc, ii, xx, yy)
-                    )(i[0], x[0], y[0])
+                    if use_tm:
+                        recon, target = forward_tm(
+                            p, ish, isc, i[0], x[0], y[0]
+                        )
+                    else:
+                        recon, target = jax.vmap(
+                            lambda ii, xx, yy: forward(p, ish, isc, ii, xx, yy)
+                        )(i[0], x[0], y[0])
                     out = (recon,) + banked_anomaly_score(
                         target, recon, esh, esc, i[0], mode=kernel_mode
                     )
@@ -1096,6 +1152,10 @@ class ModelBank:
                 "lookback": int(b.lookback),
                 "weight_bytes": int(b.weight_bytes),
                 "effective_dtype": b.effective_dtype,
+                # sequence fast-path provenance (ops/seq_scan.py):
+                # which layout/kernel the compiled scoring program uses
+                "seq_layout": getattr(b, "seq_layout", "legacy"),
+                "seq_kernel": getattr(b, "seq_kernel", "jnp"),
             }
         return out
 

@@ -288,6 +288,23 @@ def test_flops_closed_forms():
     assert conv1d_autoencoder_flops(3, (8, 4), 3, 16) == expect
 
 
+def test_lstm_flops_trip_count_explicit():
+    """The LSTM closed form is exactly lookback scan trips of the
+    per-step unit plus the Dense head — the decomposition the
+    time-major layout (ops/seq_scan.py) makes literal, and the reason
+    the closed form is layout-invariant: both layouts run the same
+    per-step math, differing only in the batched axis."""
+    from gordo_components_tpu.observability.cost import lstm_step_flops
+
+    for f, dims, T in [(3, (16,), 6), (5, (8, 4), 12)]:
+        assert lstm_stack_flops(f, dims, T) == (
+            T * lstm_step_flops(f, dims) + 2 * dims[-1] * f
+        )
+    # per-step unit: 4 gates = 8h(in+h) per layer, layers chained
+    assert lstm_step_flops(3, (16,)) == 8 * 16 * (3 + 16)
+    assert lstm_step_flops(3, (16, 4)) == 8 * 16 * 19 + 8 * 4 * 20
+
+
 def test_estimate_flops_duck_typing_and_fallback():
     from gordo_components_tpu.models.register import lookup_factory
 
@@ -358,6 +375,67 @@ def test_flops_vs_xla_cost_analysis(registry_type, kind, lookback, x_shape):
     # the backend counted, reject everything outside both bands
     assert in_band(analytic) or in_band(analytic / max(1, lookback)), (
         analytic, xla_flops, lookback,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.seqperf
+def test_flops_vs_xla_cost_analysis_time_major():
+    """The SAME analytic closed form must stay in band against XLA's
+    count of the TIME-MAJOR gang program (ops/seq_scan.py): the layout
+    re-batches the matmuls but runs identical per-step math, so
+    ``gordo_bucket_mfu`` keeps one FLOPs provenance across layouts.
+    Same asymmetric 0.4x..2x band and scan-trip-count-blindness
+    allowance as the legacy-layout leg above."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_components_tpu.models.register import lookup_factory
+    from gordo_components_tpu.ops.seq_scan import lstm_time_major_forward
+
+    M, B, T, f = 2, 4, 8, 3
+    module = lookup_factory("LSTMAutoEncoder", "lstm_symmetric")(f)
+    xb = jnp.zeros((M, B, T, f), jnp.float32)
+    params = jax.vmap(
+        lambda k: module.init(k, xb[0])
+    )(jax.random.split(jax.random.PRNGKey(0), M))
+
+    def fwd(p, x):
+        return lstm_time_major_forward(module, p, x, kernel="jnp")
+
+    try:
+        compiled = jax.jit(fwd).lower(params, xb).compile()
+        cost = compiled.cost_analysis()
+    except Exception as exc:  # pragma: no cover - backend-dependent API
+        pytest.skip(f"cost_analysis unavailable on this backend: {exc}")
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    xla_flops = float((cost or {}).get("flops") or 0.0)
+    if xla_flops <= 0:
+        pytest.skip("backend reports no flops in cost_analysis")
+    per_row = xla_flops / (M * B)
+    analytic, method = estimate_flops_per_row(module, f, T)
+    assert method == "analytic"
+    # the time-major program HOISTS the input projections out of the
+    # scan (one wide einsum per layer, counted at full trip count by
+    # XLA) while the in-loop hidden matmuls hit the trip-count-blind
+    # while-body count (once) — so the third candidate is the hoisted
+    # decomposition of the same closed form
+    inp = hid = 0.0
+    prev = f
+    for h in (int(d) for d in module.dims):
+        inp += 8.0 * h * prev
+        hid += 8.0 * h * h
+        prev = h
+    head = 2.0 * int(module.dims[-1]) * f
+    hoisted = T * inp + hid + head
+    assert abs(T * (inp + hid) + head - analytic) < 1e-6  # same closed form
+
+    def in_band(a):
+        return 0.4 * per_row <= a <= 2.0 * per_row
+
+    assert in_band(analytic) or in_band(analytic / T) or in_band(hoisted), (
+        analytic, hoisted, per_row, T,
     )
 
 

@@ -33,6 +33,12 @@ def test_run_check_end_to_end():
     cp = res["control_plane"]
     assert cp["digest_mb"] < cp["full_metadata_mb"]
     assert cp["digest_gzip_mb"] < cp["digest_mb"]
+    # sequence fast path: forced time-major must be ACTIVE and
+    # parity-clean vs legacy through training AND bank scoring
+    sf = res["seq_fleet"]
+    assert sf["layout"] == "time_major" and sf["kernel"] == "interpret"
+    assert sf["train_param_rel_err"] < 1e-3
+    assert sf["bank_score_abs_err"] < 1e-3
     assert res["peak_rss_mb"] > 0
     assert np.isfinite(
         [s["p50_ms"], s["p99_ms"], s["samples_per_sec"]]

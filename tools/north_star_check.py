@@ -758,6 +758,96 @@ def run_check(
         "digest_gzip_mb": round(len(gzip.compress(digest_json, 6)) / 1e6, 3),
     }
 
+    # ---- 8. sequence fast path (ISSUE 20): the time-major gang scan
+    # must be ACTIVE when forced (auto keeps legacy on CPU) and
+    # parity-clean against the legacy layout, end to end through both
+    # training and bank scoring — tiny shapes, this is a wiring check,
+    # not a benchmark ----
+    t0 = time.time()
+    from gordo_components_tpu.ops.seq_scan import SEQ_LAYOUT_ENV
+
+    rng = np.random.RandomState(7)
+    seq_members = {
+        f"seq-{i}": rng.rand(48, args.tags).astype("float32")
+        for i in range(3)
+    }
+    seq_cfg = dict(
+        model_type="LSTMAutoEncoder", kind="lstm_symmetric", dims=(6,),
+        lookback_window=8, epochs=1, batch_size=16, seed=0,
+    )
+    prior_layout = os.environ.get(SEQ_LAYOUT_ENV)
+    try:
+        os.environ[SEQ_LAYOUT_ENV] = "legacy"
+        leg_trainer = FleetTrainer(**seq_cfg)
+        leg_fleet = leg_trainer.fit(seq_members)
+        os.environ[SEQ_LAYOUT_ENV] = "time_major"
+        tm_trainer = FleetTrainer(**seq_cfg)
+        tm_fleet = tm_trainer.fit(seq_members)
+        tm_layouts = [
+            b["layout"] for b in tm_trainer.last_stats["buckets"]
+        ]
+        assert tm_layouts and all(l == "time_major" for l in tm_layouts), (
+            tm_layouts
+        )
+        import jax as _jax
+
+        max_err = 0.0
+        for n in seq_members:
+            for a, b in zip(
+                _jax.tree.leaves(leg_fleet[n].params),
+                _jax.tree.leaves(tm_fleet[n].params),
+            ):
+                denom = np.maximum(np.abs(np.asarray(a)), 1e-3)
+                max_err = max(
+                    max_err,
+                    float(np.max(np.abs(np.asarray(a) - np.asarray(b)) / denom)),
+                )
+        # documented fp32 band: the layouts re-associate the gate matmuls
+        assert max_err < 1e-3, max_err
+        # bank scoring through the time-major program (interpret-mode
+        # fused step = the CI parity vehicle for the Pallas kernel)
+        from gordo_components_tpu.ops.seq_scan import SEQ_KERNEL_ENV
+
+        seq_dets = {n: m.to_estimator() for n, m in tm_fleet.items()}
+        os.environ[SEQ_LAYOUT_ENV] = "legacy"
+        leg_bank = ModelBank.from_models(seq_dets)
+        os.environ[SEQ_LAYOUT_ENV] = "time_major"
+        prior_kernel = os.environ.get(SEQ_KERNEL_ENV)
+        try:
+            os.environ[SEQ_KERNEL_ENV] = "interpret"
+            tm_bank = ModelBank.from_models(seq_dets)
+            row = next(iter(tm_bank.flops_stats().values()))
+            assert row["seq_layout"] == "time_major", row
+            assert row["seq_kernel"] == "interpret", row
+            Xq = seq_members["seq-0"]
+            score_err = 0.0
+            for n in seq_members:
+                a = leg_bank.score(n, Xq)
+                b = tm_bank.score(n, Xq)
+                score_err = max(
+                    score_err,
+                    float(np.max(np.abs(a.total_scaled - b.total_scaled))),
+                )
+            assert score_err < 1e-3, score_err
+        finally:
+            if prior_kernel is None:
+                os.environ.pop(SEQ_KERNEL_ENV, None)
+            else:
+                os.environ[SEQ_KERNEL_ENV] = prior_kernel
+    finally:
+        if prior_layout is None:
+            os.environ.pop(SEQ_LAYOUT_ENV, None)
+        else:
+            os.environ[SEQ_LAYOUT_ENV] = prior_layout
+    out["seq_fleet"] = {
+        "layout": "time_major",
+        "kernel": "interpret",
+        "members": len(seq_members),
+        "train_param_rel_err": float(f"{max_err:.2e}"),
+        "bank_score_abs_err": float(f"{score_err:.2e}"),
+        "seconds": round(time.time() - t0, 1),
+    }
+
     out["peak_rss_mb"] = rss_mb()
     return out
 
